@@ -1,0 +1,17 @@
+"""flyimg-tpu: a TPU-native on-the-fly image processing framework.
+
+A brand-new implementation of the capabilities of flyimg (reference:
+/root/reference, an ImageMagick shell-out PHP microservice) re-designed
+TPU-first: the per-image `exec(convert ...)` execution model is replaced by a
+batched SPMD pixel pipeline compiled by XLA (jax.image resize, affine gathers,
+separable convolutions), a vectorized smart-crop/face model, an asyncio
+dynamic batcher, and a native C host codec layer (libjpeg-turbo / libpng /
+libwebp) feeding the device via uint8 DMA.
+
+Public surface mirrors the reference's three HTTP routes
+(`/`, `/upload/{options}/{src}`, `/path/{options}/{src}`;
+reference: config/routes.yml) and its URL options DSL
+(reference: config/parameters.yml options_keys/default_options).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,42 @@
+"""Typed exception hierarchy.
+
+Mirrors the reference's marker exceptions (reference: src/Core/Exception/*.php)
+so the HTTP layer can map failure classes to status codes the same way.
+"""
+
+
+class AppException(Exception):
+    """Base application error (reference: src/Core/Exception/AppException.php)."""
+
+
+class ExecFailedException(AppException):
+    """A processing stage failed (reference: ExecFailedException.php).
+
+    In the reference this wraps a non-zero exit code from an exec()'d binary
+    (src/Core/Processor/Processor.php:53-59); here it wraps codec or device
+    pipeline failures.
+    """
+
+
+class InvalidArgumentException(AppException):
+    """Bad request option value (reference: InvalidArgumentException.php)."""
+
+
+class MissingParamsException(AppException):
+    """Server configuration is missing a required parameter."""
+
+
+class ReadFileException(AppException):
+    """Source image could not be fetched/read (reference: ReadFileException.php,
+    raised at src/Core/Entity/Image/InputImage.php:92-97)."""
+
+
+class SecurityException(AppException):
+    """Signed-URL or domain-restriction violation (reference: SecurityException.php)."""
+
+
+class UnsupportedMediaException(AppException):
+    """Input media type needs an ingestion backend that is not available
+    (e.g. video without ffmpeg, PDF without ghostscript). Not present in the
+    reference (its Docker image bundles those binaries); this framework gates
+    them at runtime instead."""

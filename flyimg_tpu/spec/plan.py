@@ -1,0 +1,273 @@
+"""TransformPlan: the declarative device-program description.
+
+Where the reference builds one ImageMagick command string per request
+(reference: src/Core/Processor/ImageProcessor.php:66-110) and hands it to a
+shell, this framework resolves the request into a frozen ``TransformPlan``.
+The plan is hashable: it IS the compile-cache key (together with the padded
+input bucket shape), so every request with the same plan signature shares one
+XLA executable, and requests sharing a signature can be batched into a single
+device launch.
+
+Stage order preserved from the reference's command-line order (IM applies
+options left to right): geometry (resize / crop-fill / extent) -> colorspace
+-> monochrome -> rotate (with background fill) -> unsharp -> sharpen -> blur.
+The ``-filter`` option is applied to the resample itself (documented behavior,
+docs/url-options.md:236-242; in the reference snapshot the flag is emitted
+after ``-thumbnail`` and therefore silently inert — we follow the docs).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass, replace
+from typing import Optional, Tuple
+
+from flyimg_tpu.spec.colors import parse_color
+from flyimg_tpu.spec.geometry import (
+    GeometryPlan,
+    gravity_offset,
+    parse_extent,
+    resolve_geometry,
+)
+from flyimg_tpu.spec.options import OptionsBag
+
+# resize filter name -> resample method (IM filter names; jax.image methods).
+FILTER_METHODS = {
+    "lanczos": "lanczos3",
+    "triangle": "triangle",
+    "point": "nearest",
+    "box": "box",
+    "cubic": "cubic",
+    "catrom": "cubic",
+    "gaussian": "triangle",  # closest separable approximation we ship
+}
+
+_GEOM_ARG_RE = re.compile(
+    r"^(?P<radius>\d*\.?\d+)?(?:x(?P<sigma>\d*\.?\d+))?"
+    r"(?:\+(?P<gain>\d*\.?\d+))?(?:\+(?P<threshold>\d*\.?\d+))?$"
+)
+
+
+def parse_kernel_arg(
+    value: object,
+    *,
+    default_gain: float = 1.0,
+    default_threshold: float = 0.0,
+) -> Optional[Tuple[float, float, float, float]]:
+    """Parse IM's ``{radius}x{sigma}[+gain][+threshold]`` argument form used
+    by -blur/-sharpen/-unsharp (docs/url-options.md:209-234).
+
+    Returns (radius, sigma, gain, threshold). Omitted fields take the given
+    defaults per-field, matching IM which defaults each of sigma/gain/psi
+    independently of whether the others were supplied (sigma defaults to 1).
+    """
+    if value in (None, "", False):
+        return None
+    match = _GEOM_ARG_RE.match(str(value))
+    if not match:
+        return None
+    radius = float(match.group("radius") or 0.0)
+    sigma = float(match.group("sigma")) if match.group("sigma") else 1.0
+    gain = float(match.group("gain")) if match.group("gain") else default_gain
+    threshold = (
+        float(match.group("threshold"))
+        if match.group("threshold")
+        else default_threshold
+    )
+    return (radius, sigma, gain, threshold)
+
+
+@dataclass(frozen=True)
+class TransformPlan:
+    """Fully-resolved, hashable description of one image transform.
+
+    Every field is a concrete static value; nothing here depends on pixel
+    data. ``plan.signature()`` excludes the source dims so images of
+    different sizes resized to the same target can share a bucketed batch.
+    """
+
+    # geometry
+    src_size: Tuple[int, int]                      # (w, h) of decoded source
+    resize_to: Optional[Tuple[int, int]]           # resample target (w, h)
+    extent: Optional[Tuple[int, int]]              # final canvas (w, h)
+    gravity: str = "Center"
+    filter_method: str = "lanczos3"
+    # pixel ops
+    colorspace: Optional[str] = None               # 'gray' | None (sRGB no-op)
+    monochrome: bool = False
+    rotate: Optional[float] = None                 # degrees, clockwise (IM)
+    background: Optional[Tuple[int, int, int]] = None
+    unsharp: Optional[Tuple[float, float, float, float]] = None
+    sharpen: Optional[Tuple[float, float, float, float]] = None
+    blur: Optional[Tuple[float, float]] = None     # (radius, sigma)
+    # post passes (run after the main program, possibly on new geometry)
+    smart_crop: bool = False
+    face_crop: bool = False
+    face_crop_position: int = 0
+    face_blur: bool = False
+    # source pre-pass
+    extract: Optional[Tuple[int, int, int, int]] = None  # x0, y0, x1, y1
+
+    # ---- derived geometry ---------------------------------------------------
+
+    @property
+    def effective_src(self) -> Tuple[int, int]:
+        """Source dims after the extract pre-pass (if any)."""
+        if self.extract is not None:
+            x0, y0, x1, y1 = self.extract
+            return (x1 - x0, y1 - y0)
+        return self.src_size
+
+    @property
+    def final_size(self) -> Tuple[int, int]:
+        """Output (w, h) after geometry + rotate (pre smart/face post-passes)."""
+        w, h = self.effective_src
+        if self.resize_to is not None:
+            w, h = self.resize_to
+        if self.extent is not None:
+            w, h = self.extent
+        if self.rotate:
+            w, h = rotated_bounds(w, h, self.rotate)
+        return (w, h)
+
+    def crop_offset(self) -> Tuple[int, int]:
+        """Gravity offset of the extent canvas within the resized image."""
+        if self.extent is None:
+            return (0, 0)
+        cur_w, cur_h = self.resize_to if self.resize_to else self.effective_src
+        return gravity_offset(cur_w, cur_h, self.extent[0], self.extent[1], self.gravity)
+
+    # ---- caching ------------------------------------------------------------
+
+    def signature(self) -> Tuple:
+        """Compile/batch key: every field except the concrete source size.
+        Two requests with equal signatures and equal input bucket shapes run
+        the same XLA executable (and can share one batched launch)."""
+        return (
+            self.resize_to, self.extent, self.gravity, self.filter_method,
+            self.colorspace, self.monochrome, self.rotate, self.background,
+            self.unsharp, self.sharpen, self.blur, self.smart_crop,
+            self.face_crop, self.face_crop_position, self.face_blur,
+        )
+
+    def with_src(self, src_w: int, src_h: int) -> "TransformPlan":
+        return replace(self, src_size=(src_w, src_h))
+
+
+def rotated_bounds(w: int, h: int, degrees: float) -> Tuple[int, int]:
+    """Enclosing bounding box of a w x h image rotated by ``degrees``
+    (IM RotateImage grows the canvas to the rotated bounding box; for
+    multiples of 90 the dims swap exactly)."""
+    quad = degrees % 360.0
+    if quad in (0.0, 180.0):
+        return (w, h)
+    if quad in (90.0, 270.0):
+        return (h, w)
+    rad = math.radians(quad)
+    new_w = int(math.floor(abs(w * math.cos(rad)) + abs(h * math.sin(rad)) + 0.5))
+    new_h = int(math.floor(abs(w * math.sin(rad)) + abs(h * math.cos(rad)) + 0.5))
+    return (max(new_w, 1), max(new_h, 1))
+
+
+def _parse_rotate(value: object) -> Optional[float]:
+    if value in (None, "", False):
+        return None
+    try:
+        degrees = float(value)
+    except (TypeError, ValueError):
+        return None
+    if not math.isfinite(degrees):
+        return None
+    return degrees if degrees % 360.0 != 0.0 else None
+
+
+def build_plan(
+    options: OptionsBag,
+    src_w: int,
+    src_h: int,
+) -> TransformPlan:
+    """Resolve an OptionsBag + source dims into a TransformPlan.
+
+    This is the analog of ImageProcessor::generateCommand
+    (reference ImageProcessor.php:66-110) with the same option sources:
+    width/height/crop/gravity/preserve-natural-size drive geometry
+    (``calculateSize``, :115-130), colorspace/monochrome map to pixel ops,
+    and background/rotate/unsharp/sharpen/blur come from the forwarded set
+    (``checkForwardedOptions``, :303-315).
+    """
+    width = options.int_option("width")
+    height = options.int_option("height")
+    # non-positive target dims are nonsense a URL can carry; treat as unset
+    width = width if width and width > 0 else None
+    height = height if height and height > 0 else None
+    crop = options.truthy("crop")
+    pns = options.truthy("preserve-natural-size")
+    par = options.truthy("preserve-aspect-ratio")
+    gravity = str(options.get_option("gravity") or "Center")
+
+    # Extract is a source pre-pass (reference ImageHandler.php:162-165 runs
+    # ExtractProcessor before the main convert; the lazy identify that feeds
+    # geometry then sees the post-extract dims). Clamp the box to the image.
+    extract = None
+    eff_w, eff_h = src_w, src_h
+    if options.truthy("extract"):
+        coords = [options.int_option(k) for k in (
+            "extract-top-x", "extract-top-y", "extract-bottom-x", "extract-bottom-y")]
+        if all(c is not None for c in coords):
+            x0 = max(0, min(coords[0], src_w))  # type: ignore[type-var]
+            y0 = max(0, min(coords[1], src_h))  # type: ignore[type-var]
+            x1 = max(0, min(coords[2], src_w))  # type: ignore[type-var]
+            y1 = max(0, min(coords[3], src_h))  # type: ignore[type-var]
+            if x1 > x0 and y1 > y0:
+                extract = (x0, y0, x1, y1)
+                eff_w, eff_h = x1 - x0, y1 - y0
+
+    geometry: GeometryPlan = resolve_geometry(
+        eff_w, eff_h, width, height,
+        crop=crop, gravity=gravity,
+        preserve_natural_size=pns, preserve_aspect_ratio=par,
+        extent=parse_extent(options.get_option("extent")),
+    )
+
+    filter_name = str(options.get_option("filter") or "Lanczos").lower()
+    filter_method = FILTER_METHODS.get(filter_name, "lanczos3")
+    # rz_1 selects -resize over -thumbnail in the reference (ImageProcessor
+    # .php:264-272); both are the same resample here (thumbnail only adds
+    # metadata stripping, which is a host/encode concern).
+
+    colorspace_raw = str(options.get_option("colorspace") or "").lower()
+    colorspace = None
+    if colorspace_raw in ("gray", "grey", "grayscale", "lineargray", "rec709luma"):
+        colorspace = "gray"
+
+    monochrome = options.truthy("monochrome")
+
+    # IM -unsharp defaults psi (threshold) to 0.05 whenever it is absent,
+    # independent of whether gain was given (mogrify.c PsiValue handling).
+    unsharp = parse_kernel_arg(
+        options.get_option("unsharp"), default_threshold=0.05
+    )
+    sharpen = parse_kernel_arg(options.get_option("sharpen"))
+    blur_arg = parse_kernel_arg(options.get_option("blur"))
+    blur = (blur_arg[0], blur_arg[1]) if blur_arg else None
+
+    return TransformPlan(
+        src_size=(src_w, src_h),
+        resize_to=geometry.resize_to,
+        extent=geometry.extent,
+        gravity=geometry.gravity,
+        filter_method=filter_method,
+        colorspace=colorspace,
+        monochrome=monochrome,
+        rotate=_parse_rotate(options.get_option("rotate")),
+        background=parse_color(options.get_option("background")),
+        unsharp=unsharp,
+        sharpen=sharpen,
+        blur=blur,
+        smart_crop=options.truthy("smart-crop"),
+        face_crop=options.truthy("face-crop"),
+        face_crop_position=options.int_option("face-crop-position", 0) or 0,
+        face_blur=options.truthy("face-blur"),
+        extract=extract,
+    )

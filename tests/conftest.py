@@ -1,0 +1,12 @@
+"""Test harness config: force the CPU backend with a virtual 8-device mesh
+so sharding tests run anywhere (the standard fake-mesh trick; see SURVEY.md
+section 4). Must run before jax initializes a backend."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

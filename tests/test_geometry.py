@@ -1,0 +1,156 @@
+"""Geometry conformance oracle.
+
+Every case here is ported from the reference's data-provider tables in
+tests/Core/Processor/ImageProcessorTest.php (shrinkProvider:74-142,
+expandProvider:151-223, partialCropTestProvider:229-261) — the behavioral
+spec for resize semantics: no-upscale default, crop-fill '^' + gravity +
+extent, and per-axis target clamping (partial crops). Source dims match the
+reference's actual fixtures (note the portrait large fixture really is
+600x901, which pins ImageMagick's floor(x+0.5) dimension rounding:
+w_300 -> 300x451).
+"""
+
+import pytest
+
+from flyimg_tpu.spec.options import OptionsBag
+from flyimg_tpu.spec.plan import build_plan
+
+# fixture name -> (w, h), dims read from the reference's actual test images
+SQUARE = (600, 600)
+LANDSCAPE = (900, 600)
+PORTRAIT = (600, 901)
+SMALL_SQUARE = (200, 200)
+SMALL_LANDSCAPE = (300, 200)
+SMALL_PORTRAIT = (200, 300)
+
+# (options, expected 'WxH', (src_w, src_h)) — verbatim from shrinkProvider
+SHRINK_CASES = [
+    ("w_300", "300x300", SQUARE),
+    ("w_300", "300x200", LANDSCAPE),
+    ("w_300", "300x451", PORTRAIT),
+    ("h_300", "300x300", SQUARE),
+    ("h_300", "450x300", LANDSCAPE),
+    ("h_300", "200x300", PORTRAIT),
+    ("w_300,h_150", "150x150", SQUARE),
+    ("w_300,h_150", "225x150", LANDSCAPE),
+    ("w_300,h_150", "100x150", PORTRAIT),
+    ("w_150,h_300", "150x150", SQUARE),
+    ("w_150,h_300", "150x100", LANDSCAPE),
+    ("w_150,h_300", "150x225", PORTRAIT),
+    ("w_300,h_300,c_1", "300x300", SQUARE),
+    ("w_300,h_300,c_1", "300x300", LANDSCAPE),
+    ("w_300,h_300,c_1", "300x300", PORTRAIT),
+    ("w_250,h_300,c_1", "250x300", SQUARE),
+    ("w_250,h_300,c_1", "250x300", LANDSCAPE),
+    ("w_250,h_300,c_1", "250x300", PORTRAIT),
+    ("w_150,h_300,c_1", "150x300", SQUARE),
+    ("w_150,h_300,c_1", "150x300", LANDSCAPE),
+    ("w_150,h_300,c_1", "150x300", PORTRAIT),
+    ("w_300,h_250,c_1", "300x250", SQUARE),
+    ("w_300,h_250,c_1", "300x250", LANDSCAPE),
+    ("w_300,h_250,c_1", "300x250", PORTRAIT),
+    ("w_300,h_150,c_1", "300x150", SQUARE),
+    ("w_300,h_150,c_1", "300x150", LANDSCAPE),
+    ("w_300,h_150,c_1", "300x150", PORTRAIT),
+]
+
+# verbatim from expandProvider (images must never upscale by default)
+EXPAND_CASES = [
+    ("w_400", "200x200", SMALL_SQUARE),
+    ("w_400", "300x200", SMALL_LANDSCAPE),
+    ("w_400", "200x300", SMALL_PORTRAIT),
+    ("h_400", "200x200", SMALL_SQUARE),
+    ("h_400", "300x200", SMALL_LANDSCAPE),
+    ("h_400", "200x300", SMALL_PORTRAIT),
+    ("w_400,h_300", "200x200", SMALL_SQUARE),
+    ("w_400,h_300", "300x200", SMALL_LANDSCAPE),
+    ("w_400,h_350", "200x300", SMALL_PORTRAIT),
+    ("w_320,h_400", "200x200", SMALL_SQUARE),
+    ("w_320,h_400", "300x200", SMALL_LANDSCAPE),
+    ("w_320,h_400", "200x300", SMALL_PORTRAIT),
+    ("w_400,h_400,c_1", "200x200", SMALL_SQUARE),
+    ("w_400,h_400,c_1", "300x200", SMALL_LANDSCAPE),
+    ("w_400,h_400,c_1", "200x300", SMALL_PORTRAIT),
+    ("w_310,h_600,c_1", "200x200", SMALL_SQUARE),
+    ("w_310,h_600,c_1", "300x200", SMALL_LANDSCAPE),
+    ("w_310,h_600,c_1", "200x300", SMALL_PORTRAIT),
+    ("w_320,h_640,c_1", "200x200", SMALL_SQUARE),
+    ("w_320,h_640,c_1", "300x200", SMALL_LANDSCAPE),
+    ("w_320,h_400,c_1", "200x300", SMALL_PORTRAIT),
+    ("w_380,h_320,c_1", "200x200", SMALL_SQUARE),
+    ("w_380,h_320,c_1", "300x200", SMALL_LANDSCAPE),
+    ("w_380,h_320,c_1", "200x300", SMALL_PORTRAIT),
+    ("w_600,h_300,c_1", "200x200", SMALL_SQUARE),
+    ("w_600,h_300,c_1", "300x200", SMALL_LANDSCAPE),
+    ("w_600,h_300,c_1", "200x300", SMALL_PORTRAIT),
+]
+
+# verbatim from partialCropTestProvider
+PARTIAL_CROP_CASES = [
+    ("w_250,h_250,c_1", "250x200", SMALL_LANDSCAPE),
+    ("w_250,h_250,c_1", "200x250", SMALL_PORTRAIT),
+    ("w_190,h_220,c_1", "190x200", SMALL_SQUARE),
+    ("w_210,h_300,c_1", "210x200", SMALL_LANDSCAPE),
+    ("w_210,h_290,c_1", "200x290", SMALL_PORTRAIT),
+    ("w_190,h_300,c_1", "190x200", SMALL_SQUARE),
+    ("w_190,h_350,c_1", "190x200", SMALL_LANDSCAPE),
+    ("w_190,h_350,c_1", "190x300", SMALL_PORTRAIT),
+    ("w_250,h_190,c_1", "200x190", SMALL_SQUARE),
+    ("w_290,h_210,c_1", "290x200", SMALL_LANDSCAPE),
+    ("w_290,h_210,c_1", "200x210", SMALL_PORTRAIT),
+    ("w_320,h_190,c_1", "200x190", SMALL_SQUARE),
+    ("w_320,h_190,c_1", "300x190", SMALL_LANDSCAPE),
+    ("w_320,h_190,c_1", "200x190", SMALL_PORTRAIT),
+]
+
+ALL_CASES = SHRINK_CASES + EXPAND_CASES + PARTIAL_CROP_CASES
+
+
+def _final_size(options_str: str, src) -> str:
+    bag = OptionsBag(options_str)
+    plan = build_plan(bag, src[0], src[1])
+    w, h = plan.final_size
+    return f"{w}x{h}"
+
+
+@pytest.mark.parametrize("options_str,expected,src", ALL_CASES)
+def test_geometry_oracle(options_str, expected, src):
+    assert _final_size(options_str, src) == expected
+
+
+def test_pns0_allows_upscale():
+    # docs/url-options.md:317-321 — pns_0 stretches small sources up
+    assert _final_size("w_400,pns_0", SMALL_SQUARE) == "400x400"
+    assert _final_size("w_400,h_300,pns_0", SMALL_LANDSCAPE) == "400x267"
+
+
+def test_par0_distorts():
+    # docs/url-options.md:311-315 — par_0 fills the box exactly
+    assert _final_size("w_400,h_100,par_0", SQUARE) == "400x100"
+
+
+def test_rotate_bounds():
+    assert _final_size("w_300,r_90", LANDSCAPE) == "200x300"
+    assert _final_size("r_180", SMALL_SQUARE) == "200x200"
+    # 45deg bbox of a 300x200: |300c|+|200s| = 353.55 -> 354 both axes
+    assert _final_size("r_45", SMALL_LANDSCAPE) == "354x354"
+
+
+def test_extract_prepass_feeds_geometry():
+    # extract crops the source first; geometry then sees the extracted dims
+    # (reference ImageHandler.php:162-165 ordering + lazy identify)
+    bag = OptionsBag("e_1,p1x_100,p1y_100,p2x_300,p2y_200,w_100")
+    plan = build_plan(bag, 640, 360)
+    assert plan.extract == (100, 100, 300, 200)
+    assert plan.effective_src == (200, 100)
+    assert plan.final_size == (100, 50)
+
+
+def test_gravity_offsets():
+    from flyimg_tpu.spec.geometry import gravity_offset
+
+    assert gravity_offset(450, 300, 300, 300, "Center") == (75, 0)
+    assert gravity_offset(450, 300, 300, 300, "West") == (0, 0)
+    assert gravity_offset(450, 300, 300, 300, "East") == (150, 0)
+    assert gravity_offset(300, 450, 300, 300, "South") == (0, 150)
+    assert gravity_offset(300, 451, 300, 300, "Center") == (0, 75)

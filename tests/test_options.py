@@ -1,0 +1,138 @@
+"""Option-parsing conformance: short keys, defaults merge, hashing.
+
+Mirrors the reference's OutputImageTest option golden array
+(tests/Core/Entity/Image/OutputImageTest.php) and OptionsBag semantics
+(src/Core/Entity/OptionsBag.php:40-56)."""
+
+import hashlib
+
+from flyimg_tpu.spec.colors import parse_color
+from flyimg_tpu.spec.options import DEFAULT_OPTIONS, OPTIONS_KEYS, OptionsBag
+from flyimg_tpu.spec.plan import build_plan, parse_kernel_arg
+
+
+def test_defaults_applied():
+    bag = OptionsBag("")
+    assert bag.get("quality") == 90
+    assert bag.get("mozjpeg") == 1
+    assert bag.get("output") == "auto"
+    assert bag.get("gravity") == "Center"
+    assert bag.get("width") is None
+
+
+def test_full_option_string_parse():
+    # canonical option string from the reference's BaseTest ($OPTION_URL)
+    bag = OptionsBag(
+        "w_200,h_100,c_1,bg_#999999,rz_1,sc_50,r_-45,unsh_0.25x0.25+8+0.065,"
+        "ett_100x80,fb_1,rf_1"
+    )
+    assert bag.get("width") == "200"
+    assert bag.get("height") == "100"
+    assert bag.get("crop") == "1"
+    assert bag.get("background") == "#999999"
+    assert bag.get("resize") == "1"
+    assert bag.get("scale") == "50"
+    assert bag.get("rotate") == "-45"
+    assert bag.get("unsharp") == "0.25x0.25+8+0.065"
+    assert bag.get("extent") == "100x80"
+    assert bag.get("face-blur") == "1"
+    assert bag.get("refresh") == "1"
+
+
+def test_unknown_keys_ignored():
+    bag = OptionsBag("zzz_9,w_100")
+    assert bag.get("width") == "100"
+    assert not bag.has("zzz")
+
+
+def test_value_truncated_at_second_underscore():
+    # PHP explode('_')[1]: 'g_North_West' -> 'North' (reference behavior)
+    bag = OptionsBag("g_North_West")
+    assert bag.get("gravity") == "North"
+
+
+def test_time_value_with_colons_survives():
+    bag = OptionsBag("tm_00:00:10")
+    assert bag.get("time") == "00:00:10"
+
+
+def test_extract_vs_stable_views():
+    bag = OptionsBag("q_80")
+    assert bag.extract_key("quality") == "80"
+    # destructive on parsed view…
+    assert bag.get("quality") is None
+    # …but stable on the collection view (reference OptionsBag.php:12-18)
+    assert bag.get_option("quality") == "80"
+
+
+def test_hashed_options_reference_compatible():
+    """Byte-for-byte cache-name parity with the reference: md5 of PHP
+    implode('.') over merged option values + url sans query
+    (OptionsBag.php:65-77)."""
+    bag = OptionsBag("")
+    url = "https://example.com/cat.jpg?v=1"
+    values = []
+    for key, value in DEFAULT_OPTIONS.items():
+        if value is None or value is False:
+            values.append("")
+        elif value is True:
+            values.append("1")
+        else:
+            values.append(str(value))
+    expected = hashlib.md5(
+        (".".join(values) + "https://example.com/cat.jpg").encode()
+    ).hexdigest()
+    assert bag.hashed_options_as_string(url) == expected
+
+
+def test_refresh_nulled_in_hash():
+    # rf_1 must hash identically to no-refresh (OptionsBag.php:71-74)
+    url = "https://example.com/cat.jpg"
+    assert (
+        OptionsBag("w_100,rf_1").hashed_options_as_string(url)
+        == OptionsBag("w_100").hashed_options_as_string(url)
+    )
+    assert (
+        OptionsBag("w_100").hashed_options_as_string(url)
+        != OptionsBag("w_200").hashed_options_as_string(url)
+    )
+
+
+def test_original_url_hash():
+    name = OptionsBag.hash_original_image_url("https://a.b/c.png?x=1")
+    assert name == "original-" + hashlib.md5(b"https://a.b/c.png").hexdigest()
+
+
+def test_all_reference_short_keys_present():
+    # every short key from config/parameters.yml:43-80 must exist
+    for short in ("moz q o unsh sh blr fc fcp fb w h c bg st rz g f r sc sf rf "
+                  "smc ett par pns webpl gf e p1x p1y p2x p2y pg tm clsp mnchr "
+                  "dnst").split():
+        assert short in OPTIONS_KEYS, short
+
+
+def test_color_parse():
+    assert parse_color("red") == (255, 0, 0)
+    assert parse_color("%23ff4455") == (255, 68, 85)
+    assert parse_color("#999999") == (153, 153, 153)
+    assert parse_color("#abc") == (170, 187, 204)
+    assert parse_color("rgb(255,120,100)") == (255, 120, 100)
+    assert parse_color("") is None
+    assert parse_color("nonsense-color") is None
+
+
+def test_kernel_arg_parse():
+    assert parse_kernel_arg("0x6") == (0.0, 6.0, 1.0, 0.0)
+    assert parse_kernel_arg("0.25x0.25+8+0.065") == (0.25, 0.25, 8.0, 0.065)
+    assert parse_kernel_arg("2") == (2.0, 1.0, 1.0, 0.0)
+    assert parse_kernel_arg(None) is None
+
+
+def test_plan_signature_excludes_src_size():
+    # same options + same aspect ratio -> identical signature even at
+    # different source resolutions: these requests share one compiled
+    # program (and one batch) once inputs are padded to a common bucket.
+    a = build_plan(OptionsBag("w_100,h_100,c_1"), 600, 400)
+    b = build_plan(OptionsBag("w_100,h_100,c_1"), 1200, 800)
+    assert a.signature() == b.signature()
+    assert a != b
