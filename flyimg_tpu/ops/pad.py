@@ -1,0 +1,45 @@
+"""Extent padding: place an image on a larger canvas per gravity.
+
+The crop direction of ``-extent`` is fused into the windowed resample
+(ops/resample.py); this op covers the pad direction — target canvas larger
+than the image (the ``ett_WxH`` option, and rounding slack in crop-fill),
+filled with the background color (IM default white).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+
+def extent_pad(
+    image: jnp.ndarray,
+    canvas_wh: Tuple[int, int],
+    offset_xy: Tuple[int, int],
+    background: Optional[Tuple[int, int, int]] = None,
+) -> jnp.ndarray:
+    """Place [H, W, C] at (offset_x, offset_y) on a (canvas_w, canvas_h)
+    canvas. Offsets may be negative (image cropped by canvas edge); all
+    values static. Matches IM gravity/extent composition."""
+    canvas_w, canvas_h = canvas_wh
+    off_x, off_y = offset_xy
+    h, w = int(image.shape[0]), int(image.shape[1])
+    bg = jnp.array(background or (255, 255, 255), dtype=image.dtype)
+
+    src_x0 = max(0, -off_x)
+    src_y0 = max(0, -off_y)
+    dst_x0 = max(0, off_x)
+    dst_y0 = max(0, off_y)
+    copy_w = min(w - src_x0, canvas_w - dst_x0)
+    copy_h = min(h - src_y0, canvas_h - dst_y0)
+    if copy_w <= 0 or copy_h <= 0:
+        return jnp.broadcast_to(
+            bg, (canvas_h, canvas_w, image.shape[-1])
+        ).astype(image.dtype)
+
+    canvas = jnp.broadcast_to(bg, (canvas_h, canvas_w, image.shape[-1]))
+    piece = image[src_y0 : src_y0 + copy_h, src_x0 : src_x0 + copy_w]
+    return canvas.astype(image.dtype).at[
+        dst_y0 : dst_y0 + copy_h, dst_x0 : dst_x0 + copy_w
+    ].set(piece)

@@ -1,0 +1,56 @@
+"""Region pixelation for face blur.
+
+Replaces the reference's per-face ``mogrify -gravity NorthWest -region
+WxH+X+Y -scale 10% -scale 1000%`` (reference
+src/Core/Processor/FaceDetectProcessor.php:51-76) — pixelation by 10x
+down/up scaling inside each face rectangle.
+
+TPU-first shape: instead of one exec per face, the WHOLE image is block-
+averaged once (the 10%/1000% round trip == average over aligned 10x10
+blocks, nearest-upsampled), then a per-pixel mask selects the pixelated
+value inside any of the (padded, dynamic) face boxes. One fused program,
+any number of faces, fully batchable.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# the reference's -scale 10% ... 1000% round trip = factor-10 blocks
+PIXELATE_FACTOR = 10
+
+
+def _block_pixelate(image: jnp.ndarray, factor: int) -> jnp.ndarray:
+    """Average over factor x factor blocks, then nearest-upsample back.
+    Handles non-multiple sizes by edge-padding the partial blocks."""
+    h, w, c = image.shape
+    ph = (-h) % factor
+    pw = (-w) % factor
+    padded = jnp.pad(image, ((0, ph), (0, pw), (0, 0)), mode="edge")
+    hb, wb = padded.shape[0] // factor, padded.shape[1] // factor
+    blocks = padded.reshape(hb, factor, wb, factor, c).mean(axis=(1, 3))
+    up = jnp.repeat(jnp.repeat(blocks, factor, axis=0), factor, axis=1)
+    return up[:h, :w]
+
+
+def pixelate_regions(
+    image: jnp.ndarray,
+    boxes: jnp.ndarray,
+    factor: int = PIXELATE_FACTOR,
+) -> jnp.ndarray:
+    """Pixelate inside each box of ``boxes`` [N, 4] = (x, y, w, h) float/int;
+    zero-area boxes are inert padding, so callers can pad to a static N."""
+    pixelated = _block_pixelate(image, factor)
+    h, w = image.shape[0], image.shape[1]
+    ys = jnp.arange(h, dtype=jnp.float32)[:, None]
+    xs = jnp.arange(w, dtype=jnp.float32)[None, :]
+    boxes = boxes.astype(jnp.float32)
+
+    def box_mask(box):
+        x, y, bw, bh = box[0], box[1], box[2], box[3]
+        return (xs >= x) & (xs < x + bw) & (ys >= y) & (ys < y + bh)
+
+    masks = jax.vmap(box_mask)(boxes)
+    inside = jnp.any(masks, axis=0)[..., None]
+    return jnp.where(inside, pixelated, image)

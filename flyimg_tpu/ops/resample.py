@@ -1,0 +1,128 @@
+"""Windowed separable resampling as MXU einsums.
+
+This is the framework's core kernel and its central TPU-first design move:
+the reference's whole geometry chain — extract crop, fill-resize, gravity
+crop/extent (reference src/Core/Processor/ImageProcessor.php:115-162 emitting
+``-thumbnail WxH^ -gravity G -extent WxH``) — collapses into ONE windowed
+resample per axis: output pixel i samples source coordinate
+
+    x(i) = span_start + (i + 0.5) * span_size / out_true - 0.5
+
+so a crop is just a span smaller than the image and a resize is just
+out != span. The per-output-row filter weights form a dense [out, in]
+matrix computed from *traced* scalars (span, true sizes) — meaning one
+compiled program serves every source size in a padded bucket, and the
+two per-axis weight applications are einsums that XLA tiles onto the MXU.
+
+Filter kernels mirror ImageMagick's resize filters (magick/resize.c):
+lanczos3 (IM default 'Lanczos'), triangle, mitchell ('Cubic'/'Catrom'
+approximation), box, nearest ('Point'). Downscale antialiasing stretches the
+kernel by the scale factor and renormalizes, like IM's support scaling.
+
+Edge policy: sample coordinates are clamped to [0, true-1] and taps beyond
+the image's true extent are masked then rows renormalized — equivalent to
+IM's edge virtual-pixel handling, and it makes bucket padding invisible
+(padding pixels get zero weight, so zero-padded H2D buffers are safe).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+# kernel support radius at scale 1
+KERNEL_RADIUS = {
+    "lanczos3": 3.0,
+    "triangle": 1.0,
+    "cubic": 2.0,
+    "box": 0.5,
+    "nearest": 0.5,
+}
+
+
+def _kernel_fn(method: str, x: jnp.ndarray) -> jnp.ndarray:
+    if method == "lanczos3":
+        return jnp.where(jnp.abs(x) < 3.0, jnp.sinc(x) * jnp.sinc(x / 3.0), 0.0)
+    if method == "triangle":
+        return jnp.maximum(0.0, 1.0 - jnp.abs(x))
+    if method == "cubic":
+        # Mitchell-Netravali B=C=1/3 (IM's general-purpose cubic)
+        b, c = 1.0 / 3.0, 1.0 / 3.0
+        ax = jnp.abs(x)
+        ax2, ax3 = ax * ax, ax * ax * ax
+        p1 = ((12 - 9 * b - 6 * c) * ax3 + (-18 + 12 * b + 6 * c) * ax2 + (6 - 2 * b)) / 6.0
+        p2 = ((-b - 6 * c) * ax3 + (6 * b + 30 * c) * ax2 + (-12 * b - 48 * c) * ax + (8 * b + 24 * c)) / 6.0
+        return jnp.where(ax < 1.0, p1, jnp.where(ax < 2.0, p2, 0.0))
+    if method in ("box", "nearest"):
+        return jnp.where((x >= -0.5) & (x < 0.5), 1.0, 0.0)
+    raise ValueError(f"unknown resample method: {method}")
+
+
+def resample_matrix(
+    in_size: int,
+    out_size: int,
+    span_start: jnp.ndarray,
+    span_size: jnp.ndarray,
+    out_true: jnp.ndarray,
+    in_true: jnp.ndarray,
+    method: str = "lanczos3",
+) -> jnp.ndarray:
+    """Dense [out_size, in_size] weight matrix for one axis.
+
+    ``in_size``/``out_size`` are the STATIC (bucket) sizes; ``span_start``,
+    ``span_size`` (source window), ``out_true`` (valid output extent) and
+    ``in_true`` (valid input extent) are traced scalars, so the same
+    executable serves any image in the bucket. Rows at i >= out_true are
+    edge-replicated don't-cares (the host slices the valid region).
+    """
+    span_start = jnp.asarray(span_start, jnp.float32)
+    span_size = jnp.asarray(span_size, jnp.float32)
+    out_true = jnp.asarray(out_true, jnp.float32)
+    in_true = jnp.asarray(in_true, jnp.float32)
+
+    i = jnp.arange(out_size, dtype=jnp.float32)
+    j = jnp.arange(in_size, dtype=jnp.float32)
+    x = span_start + (i + 0.5) * (span_size / jnp.maximum(out_true, 1.0)) - 0.5
+    x = jnp.clip(x, 0.0, jnp.maximum(in_true - 1.0, 0.0))
+
+    if method == "nearest":
+        # IM 'Point': one-hot at the floor-rounded sample position
+        idx = jnp.clip(jnp.floor(x + 0.5), 0.0, jnp.maximum(in_true - 1.0, 0.0))
+        return (j[None, :] == idx[:, None]).astype(jnp.float32)
+
+    # antialias: stretch kernel by the downscale factor (never below 1)
+    s = jnp.maximum(span_size / jnp.maximum(out_true, 1.0), 1.0)
+    d = (j[None, :] - x[:, None]) / s
+    w = _kernel_fn(method, d)
+    w = jnp.where(j[None, :] < in_true, w, 0.0)
+    denom = jnp.sum(w, axis=-1, keepdims=True)
+    return w / jnp.where(denom == 0.0, 1.0, denom)
+
+
+def resample_image(
+    image: jnp.ndarray,
+    out_hw: Tuple[int, int],
+    span_y: jnp.ndarray,
+    span_x: jnp.ndarray,
+    out_true_hw: jnp.ndarray,
+    in_true_hw: jnp.ndarray,
+    method: str = "lanczos3",
+) -> jnp.ndarray:
+    """Resample one [H, W, C] float image to static [out_h, out_w, C].
+
+    ``span_y``/``span_x`` are (start, size) source windows per axis;
+    ``out_true_hw``/``in_true_hw`` are (h, w) valid extents. All four may be
+    traced. Two einsums -> both land on the MXU.
+    """
+    in_h, in_w = image.shape[0], image.shape[1]
+    out_h, out_w = out_hw
+    wy = resample_matrix(
+        in_h, out_h, span_y[0], span_y[1], out_true_hw[0], in_true_hw[0], method
+    )
+    wx = resample_matrix(
+        in_w, out_w, span_x[0], span_x[1], out_true_hw[1], in_true_hw[1], method
+    )
+    tmp = jnp.einsum("oh,hwc->owc", wy, image, precision=jax.lax.Precision.HIGHEST)
+    return jnp.einsum("ow,hwc->hoc", wx, tmp, precision=jax.lax.Precision.HIGHEST)

@@ -1,0 +1,84 @@
+"""Rotation with background fill.
+
+Replaces ImageMagick's shear-based -rotate (reference forwards it at
+src/Core/Processor/ImageProcessor.php:303-315; docs/url-options.md:100-110).
+Multiples of 90 are exact transposes/flips. Arbitrary angles use an inverse
+affine map with bilinear sampling into the enclosing bounding box, corners
+filled with the background color (IM fills with -background, default white).
+
+DIVERGENCE: IM rotates via three shear passes with filter resampling; the
+single-pass bilinear gather differs by sub-pixel interpolation detail but is
+one fused XLA gather instead of three memory-bound passes.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+from flyimg_tpu.spec.plan import rotated_bounds
+
+
+def rotate_image(
+    image: jnp.ndarray,
+    degrees: float,
+    background: Optional[Tuple[int, int, int]] = None,
+) -> jnp.ndarray:
+    """Rotate [H, W, C] clockwise by ``degrees`` (IM convention: positive
+    angles rotate clockwise). Output is the static enclosing bbox."""
+    quad = degrees % 360.0
+    if quad == 0.0:
+        return image
+    if quad == 90.0:
+        return jnp.flip(jnp.swapaxes(image, 0, 1), axis=1)
+    if quad == 180.0:
+        return jnp.flip(image, axis=(0, 1))
+    if quad == 270.0:
+        return jnp.flip(jnp.swapaxes(image, 0, 1), axis=0)
+
+    h, w = int(image.shape[0]), int(image.shape[1])
+    out_w, out_h = rotated_bounds(w, h, degrees)
+    bg = jnp.array(background or (255, 255, 255), dtype=image.dtype)
+
+    # inverse map: for each output pixel, the source coordinate that lands
+    # there under a clockwise rotation about the image center
+    theta = math.radians(quad)
+    cos_t, sin_t = math.cos(theta), math.sin(theta)
+    yo, xo = jnp.meshgrid(
+        jnp.arange(out_h, dtype=jnp.float32),
+        jnp.arange(out_w, dtype=jnp.float32),
+        indexing="ij",
+    )
+    cy_out, cx_out = (out_h - 1) / 2.0, (out_w - 1) / 2.0
+    cy_in, cx_in = (h - 1) / 2.0, (w - 1) / 2.0
+    dx = xo - cx_out
+    dy = yo - cy_out
+    # screen coords (y down): clockwise rotation forward = [cos -sin; sin cos];
+    # inverse rotates by -theta
+    xs = cos_t * dx + sin_t * dy + cx_in
+    ys = -sin_t * dx + cos_t * dy + cy_in
+
+    x0 = jnp.floor(xs)
+    y0 = jnp.floor(ys)
+    fx = (xs - x0)[..., None]
+    fy = (ys - y0)[..., None]
+
+    def gather(yy, xx):
+        yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+        return image[yc, xc]
+
+    p00 = gather(y0, x0)
+    p01 = gather(y0, x0 + 1)
+    p10 = gather(y0 + 1, x0)
+    p11 = gather(y0 + 1, x0 + 1)
+    top = p00 * (1 - fx) + p01 * fx
+    bot = p10 * (1 - fx) + p11 * fx
+    sampled = top * (1 - fy) + bot * fy
+
+    inside = (
+        (xs >= -0.5) & (xs <= w - 0.5) & (ys >= -0.5) & (ys <= h - 0.5)
+    )[..., None]
+    return jnp.where(inside, sampled, bg)

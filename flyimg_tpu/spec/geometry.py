@@ -31,6 +31,14 @@ GRAVITIES = (
     "South",
     "SouthEast",
 )
+# IM parses gravity case-insensitively; unknown values fall back to Center.
+_GRAVITY_BY_LOWER = {g.lower(): g for g in GRAVITIES}
+
+
+def normalize_gravity(value: object) -> str:
+    if isinstance(value, str):
+        return _GRAVITY_BY_LOWER.get(value.strip().lower(), "Center")
+    return "Center"
 
 
 def _round_dim(value: float) -> int:
@@ -112,8 +120,7 @@ def gravity_offset(
     a canvas of (canvas_w, canvas_h) per IM gravity. Offsets can be negative
     when the region is larger than the canvas (extent-padding case). Division
     truncates toward zero like the C code."""
-    if gravity not in GRAVITIES:
-        gravity = "Center"
+    gravity = normalize_gravity(gravity)
     dx = canvas_w - region_w
     dy = canvas_h - region_h
     if gravity in ("NorthWest", "West", "SouthWest"):
@@ -206,5 +213,5 @@ def resolve_geometry(
         src=(src_w, src_h),
         resize_to=resize_to,
         extent=extent_out,
-        gravity=gravity if gravity in GRAVITIES else "Center",
+        gravity=normalize_gravity(gravity),
     )

@@ -21,6 +21,7 @@ Parsing rules preserved exactly:
 from __future__ import annotations
 
 import hashlib
+import re
 from typing import Any, Dict, Optional
 
 # reference: config/parameters.yml:43-80 (options_keys)
@@ -211,10 +212,14 @@ class OptionsBag:
         try:
             return int(value)
         except (TypeError, ValueError):
-            # IM parses geometry numbers with strtod, so 'w_200.5' resizes
-            # to ~200px there; truncate decimals rather than dropping the op.
+            # IM parses geometry numbers with strtod: leading numeric prefix,
+            # trailing garbage ignored — 'w_200.5' resizes to ~200, 'w_200px'
+            # to 200. Match that rather than dropping the op.
+            match = re.match(r"\s*[-+]?\d*\.?\d+", str(value))
+            if not match:
+                return default
             try:
-                return int(float(value))
+                return int(float(match.group(0)))
             except (TypeError, ValueError, OverflowError):
                 return default
 
@@ -229,6 +234,10 @@ class OptionsBag:
 
     def truthy(self, key: str) -> bool:
         """PHP-style truthiness used all over the reference handler
-        (e.g. ``if ($smartCrop && ...)``): '', '0', 0, None, False are falsy."""
+        (e.g. ``if ($smartCrop && ...)``): '', '0', 0, None, False are falsy —
+        and, faithfully to PHP, the STRING 'false' is truthy (so ``c_false``
+        does enable cropping, exactly as in the reference)."""
         value = self.get_option(key)
-        return bool(value) and str(value) not in ("0", "", "False", "false")
+        if value is None or value is False:
+            return False
+        return str(value) not in ("0", "")

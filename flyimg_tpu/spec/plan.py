@@ -154,6 +154,26 @@ class TransformPlan:
     def with_src(self, src_w: int, src_h: int) -> "TransformPlan":
         return replace(self, src_size=(src_w, src_h))
 
+    def device_plan(self) -> "TransformPlan":
+        """Canonical form for the program compile cache: geometry fields
+        (src/resize/extent/gravity/extract) are zeroed because they reach the
+        device program as traced spans or separate static args, and the
+        smart/face post-pass flags are dropped because they run as separate
+        programs. Only fields the compiled pixel program actually reads
+        (filter, color ops, rotate, background, conv kernels) survive."""
+        return replace(
+            self,
+            src_size=(0, 0),
+            resize_to=None,
+            extent=None,
+            gravity="Center",
+            extract=None,
+            smart_crop=False,
+            face_crop=False,
+            face_crop_position=0,
+            face_blur=False,
+        )
+
 
 def rotated_bounds(w: int, h: int, degrees: float) -> Tuple[int, int]:
     """Enclosing bounding box of a w x h image rotated by ``degrees``
