@@ -1,0 +1,76 @@
+"""Server configuration (the reference's parameters.yml tier).
+
+Mirrors AppParameters (reference src/Core/Entity/AppParameters.php): a YAML
+file of server-level settings merged over built-in defaults that match
+reference config/parameters.yml:1-41. Per-request options live in
+flyimg_tpu.spec.options; this is only the server tier.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+try:
+    import yaml
+except ImportError:  # pragma: no cover - pyyaml is present in this image
+    yaml = None
+
+from flyimg_tpu.spec.options import DEFAULT_OPTIONS, OPTIONS_KEYS
+
+# reference config/parameters.yml defaults
+SERVER_DEFAULTS: Dict[str, Any] = {
+    "application_name": "flyimg-tpu",
+    "debug": False,
+    "header_cache_days": 365,
+    "options_separator": ",",
+    "security_key": "",
+    "security_iv": "",
+    "restricted_domains": False,
+    "whitelist_domains": [],
+    "storage_system": "local",
+    "aws_s3": {"access_id": "", "secret_key": "", "region": "", "bucket_name": ""},
+    "header_extra_options": (
+        "User-Agent: Mozilla/5.0 (Windows; U; Windows NT 6.1; rv:2.2) "
+        "Gecko/20110201"
+    ),
+    "options_keys": dict(OPTIONS_KEYS),
+    "default_options": dict(DEFAULT_OPTIONS),
+    # --- TPU-framework additions (no reference analog) ---
+    "upload_dir": "web/uploads",
+    "tmp_dir": "var/tmp",
+    "batch_max_size": 64,
+    "batch_deadline_ms": 4.0,
+    "device_mesh": "auto",
+}
+
+
+class AppParameters:
+    """Loaded server parameters with reference-compatible accessors."""
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None) -> None:
+        merged = dict(SERVER_DEFAULTS)
+        if params:
+            for key, value in params.items():
+                merged[key] = value
+        self._params = merged
+
+    @classmethod
+    def from_yaml(cls, path: str) -> "AppParameters":
+        if not os.path.exists(path):
+            raise FileNotFoundError(path)
+        if yaml is None:
+            raise RuntimeError("pyyaml unavailable; cannot load parameters file")
+        with open(path, "r", encoding="utf-8") as fh:
+            loaded = yaml.safe_load(fh) or {}
+        return cls(loaded)
+
+    def by_key(self, key: str, default: Any = None) -> Any:
+        """parameterByKey (reference AppParameters.php:35-44)."""
+        return self._params.get(key, default)
+
+    def add(self, key: str, value: Any) -> None:
+        self._params[key] = value
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dict(self._params)

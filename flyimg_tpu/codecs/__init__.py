@@ -1,0 +1,112 @@
+"""Host codec layer facade.
+
+Decode/encode dispatch: the native C codec (codecs/native, libjpeg + libwebp,
+built on demand) takes the hot JPEG/WebP paths; PIL covers everything else
+(PNG, GIF, alpha-carrying encodes). This layer replaces the reference's codec
+binaries (ImageMagick decode, MozJPEG cjpeg, cwebp — reference
+src/Core/Processor/Processor.php:15-33) with in-process calls, so image
+bytes never cross a process boundary on the way to the device.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from flyimg_tpu.codecs.sniff import MediaInfo, sniff  # noqa: F401
+from flyimg_tpu.codecs import native_codec
+from flyimg_tpu.codecs import pil_codec
+from flyimg_tpu.codecs.exif import apply_orientation, jpeg_orientation
+from flyimg_tpu.codecs.pil_codec import DecodedImage
+
+
+def _dct_scale_num(src_w: int, src_h: int, hint: Tuple[int, int]) -> int:
+    """Smallest libjpeg DCT scale (scale_num/8) that keeps the decoded image
+    >= 2x the target box on both axes, so the device resample remains the
+    quality-determining step."""
+    tw, th = hint
+    if not tw or not th or src_w <= 0 or src_h <= 0:
+        return 8
+    for scale_num in (1, 2, 4, 8):  # 1/8, 1/4, 1/2, 1/1
+        if src_w * scale_num >= tw * 2 * 8 and src_h * scale_num >= th * 2 * 8:
+            return scale_num
+    return 8
+
+
+def decode(
+    data: bytes,
+    *,
+    target_hint: Optional[Tuple[int, int]] = None,
+    frame: int = 0,
+) -> DecodedImage:
+    """Decode bytes -> DecodedImage. JPEG/WebP ride the native codec when
+    built; everything else (and all alpha/animation handling) uses PIL."""
+    info = sniff(data[:65536])
+    if native_codec.available():
+        if info.mime == "image/jpeg":
+            scale_num = 8
+            if target_hint and info.width and info.height:
+                scale_num = _dct_scale_num(info.width, info.height, target_hint)
+            rgb = native_codec.jpeg_decode(data, scale_num)
+            if rgb is not None:
+                orientation = jpeg_orientation(data)
+                rgb = np.ascontiguousarray(apply_orientation(rgb, orientation))
+                return DecodedImage(
+                    rgb=rgb,
+                    alpha=None,
+                    mime="image/jpeg",
+                    orig_size=(info.width or rgb.shape[1], info.height or rgb.shape[0]),
+                )
+        elif info.mime == "image/webp" and frame == 0:
+            rgb = native_codec.webp_decode(data)
+            if rgb is not None:
+                return DecodedImage(
+                    rgb=np.ascontiguousarray(rgb),
+                    alpha=None,
+                    mime="image/webp",
+                    orig_size=(rgb.shape[1], rgb.shape[0]),
+                )
+    return pil_codec.decode(data, target_hint=target_hint, frame=frame)
+
+
+def encode(
+    image: np.ndarray,
+    fmt: str,
+    *,
+    quality: int = 90,
+    webp_lossless: bool = False,
+    mozjpeg: bool = True,
+    sampling_factor: str = "1x1",
+    strip: bool = True,
+    alpha: Optional[np.ndarray] = None,
+) -> bytes:
+    """Encode via the native codec where it covers the case (jpg, webp
+    without alpha); PIL otherwise."""
+    if native_codec.available() and alpha is None:
+        if fmt in ("jpg", "jpeg"):
+            blob = native_codec.jpeg_encode(
+                image,
+                quality,
+                optimize=bool(mozjpeg),
+                progressive=bool(mozjpeg),
+                subsampling_444=(sampling_factor == "1x1"),
+            )
+            if blob is not None:
+                return blob
+        elif fmt == "webp":
+            blob = native_codec.webp_encode(
+                image, quality, lossless=bool(webp_lossless)
+            )
+            if blob is not None:
+                return blob
+    return pil_codec.encode(
+        image,
+        fmt,
+        quality=quality,
+        webp_lossless=webp_lossless,
+        mozjpeg=mozjpeg,
+        sampling_factor=sampling_factor,
+        strip=strip,
+        alpha=alpha,
+    )

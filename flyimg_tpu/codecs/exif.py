@@ -1,0 +1,75 @@
+"""Minimal EXIF orientation reader + applier.
+
+The reference always emits ``-auto-orient`` (src/Core/Processor/
+ImageProcessor.php:78); the native JPEG decode path bypasses PIL, so
+orientation is parsed here directly from the APP1/TIFF header (tag 0x0112)
+and applied as numpy flips/transposes (exact, copy-light).
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+
+def jpeg_orientation(data: bytes) -> int:
+    """EXIF orientation 1..8 (1 = upright) from JPEG bytes; 1 on any parse
+    failure."""
+    try:
+        i = 2
+        n = min(len(data), 256 * 1024)
+        while i + 4 < n:
+            if data[i] != 0xFF:
+                return 1
+            marker = data[i + 1]
+            if marker == 0xD8:
+                i += 2
+                continue
+            if marker in (0xDA, 0xD9):  # start of scan / end
+                return 1
+            seglen = struct.unpack(">H", data[i + 2 : i + 4])[0]
+            if marker == 0xE1 and data[i + 4 : i + 10] == b"Exif\x00\x00":
+                tiff = i + 10
+                if data[tiff : tiff + 2] == b"II":
+                    endian = "<"
+                elif data[tiff : tiff + 2] == b"MM":
+                    endian = ">"
+                else:
+                    return 1
+                (ifd_off,) = struct.unpack(endian + "I", data[tiff + 4 : tiff + 8])
+                ifd = tiff + ifd_off
+                (count,) = struct.unpack(endian + "H", data[ifd : ifd + 2])
+                for k in range(count):
+                    entry = ifd + 2 + 12 * k
+                    (tag,) = struct.unpack(endian + "H", data[entry : entry + 2])
+                    if tag == 0x0112:
+                        (value,) = struct.unpack(
+                            endian + "H", data[entry + 8 : entry + 10]
+                        )
+                        return value if 1 <= value <= 8 else 1
+                return 1
+            i += 2 + seglen
+        return 1
+    except (struct.error, IndexError):
+        return 1
+
+
+def apply_orientation(rgb: np.ndarray, orientation: int) -> np.ndarray:
+    """Apply EXIF orientation 1..8 to [h, w, c] (same transform set PIL's
+    exif_transpose performs)."""
+    if orientation == 2:
+        return np.flip(rgb, axis=1)
+    if orientation == 3:
+        return np.flip(rgb, axis=(0, 1))
+    if orientation == 4:
+        return np.flip(rgb, axis=0)
+    if orientation == 5:
+        return np.swapaxes(rgb, 0, 1)
+    if orientation == 6:
+        return np.flip(np.swapaxes(rgb, 0, 1), axis=1)
+    if orientation == 7:
+        return np.flip(np.swapaxes(rgb, 0, 1), axis=(0, 1))
+    if orientation == 8:
+        return np.flip(np.swapaxes(rgb, 0, 1), axis=0)
+    return rgb

@@ -1,0 +1,261 @@
+// fastcodec: native host codec layer for flyimg-tpu.
+//
+// The TPU-native replacement for the reference's codec binaries — the decode
+// half of ImageMagick `convert` and the encode side of MozJPEG `cjpeg` /
+// `cwebp` (reference src/Core/Processor/Processor.php:15-33 hard-codes those
+// binary paths; here the same work is an in-process library so image bytes
+// never cross a process boundary on the way to the device).
+//
+// Design:
+//  - Plain C ABI (ctypes-friendly), all buffers malloc'd here and released
+//    via fc_free; no global state, safe to call from many threads at once.
+//  - JPEG via libjpeg(-turbo): decode with optional DCT scaling
+//    (scale 1/1..1/8 — the decode-time prescale that feeds 4k sources to
+//    thumbnail pipelines cheaply), encode with optimized Huffman tables +
+//    optional progressive scan script (the two headline MozJPEG techniques).
+//  - WebP via libwebp: lossy (quality) and lossless encode, decode to RGB.
+//  - A worker pool (fc_pool_*) so a multi-core host can saturate decode
+//    while the GIL is released on the Python side.
+
+#include <csetjmp>
+#include <cstdint>
+#include <cstdio>  // jpeglib.h needs FILE declared
+#include <cstdlib>
+#include <cstring>
+
+#include <jpeglib.h>
+#include <webp/decode.h>
+#include <webp/encode.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <functional>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// common
+// ---------------------------------------------------------------------------
+
+void fc_free(void* ptr) { std::free(ptr); }
+
+const char* fc_version() { return "fastcodec-1.0"; }
+
+// ---------------------------------------------------------------------------
+// JPEG
+// ---------------------------------------------------------------------------
+
+struct fc_jpeg_error_mgr {
+  jpeg_error_mgr pub;
+  jmp_buf setjmp_buffer;
+};
+
+static void fc_jpeg_error_exit(j_common_ptr cinfo) {
+  auto* err = reinterpret_cast<fc_jpeg_error_mgr*>(cinfo->err);
+  longjmp(err->setjmp_buffer, 1);
+}
+
+// Decode a JPEG buffer to RGB. scale_num/8 is the libjpeg DCT scale
+// (pass 8 for full size, 4 for 1/2, 2 for 1/4, 1 for 1/8).
+// Returns malloc'd RGB8 buffer or nullptr; fills width/height.
+uint8_t* fc_jpeg_decode(const uint8_t* data, size_t len, int scale_num,
+                        int* width, int* height) {
+  jpeg_decompress_struct cinfo;
+  fc_jpeg_error_mgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = fc_jpeg_error_exit;
+  uint8_t* out = nullptr;
+  if (setjmp(jerr.setjmp_buffer)) {
+    jpeg_destroy_decompress(&cinfo);
+    std::free(out);
+    return nullptr;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, data, len);
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return nullptr;
+  }
+  cinfo.out_color_space = JCS_RGB;
+  if (scale_num >= 1 && scale_num <= 8) {
+    cinfo.scale_num = scale_num;
+    cinfo.scale_denom = 8;
+  }
+  // fastest safe knobs: merged upsampling stays on by default
+  cinfo.do_fancy_upsampling = TRUE;
+  jpeg_start_decompress(&cinfo);
+  const int w = cinfo.output_width;
+  const int h = cinfo.output_height;
+  const int stride = w * 3;
+  out = static_cast<uint8_t*>(std::malloc(static_cast<size_t>(stride) * h));
+  if (!out) {
+    jpeg_abort_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    return nullptr;
+  }
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row = out + static_cast<size_t>(cinfo.output_scanline) * stride;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  *width = w;
+  *height = h;
+  return out;
+}
+
+// Encode RGB8 to JPEG. quality 0..100; optimize!=0 enables optimized Huffman
+// tables; progressive!=0 enables the progressive scan script; subsampling:
+// 0 = 4:4:4 (the reference's default sampling-factor 1x1,
+// config/parameters.yml:103), 2 = 4:2:0.
+uint8_t* fc_jpeg_encode(const uint8_t* rgb, int width, int height, int quality,
+                        int optimize, int progressive, int subsampling,
+                        size_t* out_len) {
+  jpeg_compress_struct cinfo;
+  fc_jpeg_error_mgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = fc_jpeg_error_exit;
+  unsigned char* mem = nullptr;
+  unsigned long mem_len = 0;
+  if (setjmp(jerr.setjmp_buffer)) {
+    jpeg_destroy_compress(&cinfo);
+    std::free(mem);
+    return nullptr;
+  }
+  jpeg_create_compress(&cinfo);
+  jpeg_mem_dest(&cinfo, &mem, &mem_len);
+  cinfo.image_width = width;
+  cinfo.image_height = height;
+  cinfo.input_components = 3;
+  cinfo.in_color_space = JCS_RGB;
+  jpeg_set_defaults(&cinfo);
+  jpeg_set_quality(&cinfo, quality, TRUE);
+  cinfo.optimize_coding = optimize ? TRUE : FALSE;
+  if (progressive) jpeg_simple_progression(&cinfo);
+  if (subsampling == 0) {
+    // 4:4:4 — no chroma subsampling
+    for (int i = 0; i < cinfo.num_components; ++i) {
+      cinfo.comp_info[i].h_samp_factor = 1;
+      cinfo.comp_info[i].v_samp_factor = 1;
+    }
+  }
+  jpeg_start_compress(&cinfo, TRUE);
+  const int stride = width * 3;
+  while (cinfo.next_scanline < cinfo.image_height) {
+    const uint8_t* row = rgb + static_cast<size_t>(cinfo.next_scanline) * stride;
+    JSAMPROW rows[1] = {const_cast<uint8_t*>(row)};
+    jpeg_write_scanlines(&cinfo, rows, 1);
+  }
+  jpeg_finish_compress(&cinfo);
+  jpeg_destroy_compress(&cinfo);
+  *out_len = mem_len;
+  // hand back a malloc'd copy so fc_free() semantics are uniform
+  uint8_t* out = static_cast<uint8_t*>(std::malloc(mem_len));
+  if (out) std::memcpy(out, mem, mem_len);
+  std::free(mem);
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// WebP
+// ---------------------------------------------------------------------------
+
+uint8_t* fc_webp_decode(const uint8_t* data, size_t len, int* width,
+                        int* height) {
+  return WebPDecodeRGB(data, len, width, height);
+}
+
+uint8_t* fc_webp_encode(const uint8_t* rgb, int width, int height,
+                        float quality, int lossless, size_t* out_len) {
+  uint8_t* out = nullptr;
+  size_t n;
+  if (lossless) {
+    n = WebPEncodeLosslessRGB(rgb, width, height, width * 3, &out);
+  } else {
+    n = WebPEncodeRGB(rgb, width, height, width * 3, quality, &out);
+  }
+  if (n == 0) return nullptr;
+  *out_len = n;
+  return out;  // WebP uses malloc-compatible allocation; fc_free works
+}
+
+// ---------------------------------------------------------------------------
+// worker pool: parallel decode/encode on the host while Python's GIL is
+// released (the ctypes call site releases it automatically).
+// ---------------------------------------------------------------------------
+
+struct fc_pool {
+  std::vector<std::thread> workers;
+  std::queue<std::function<void()>> tasks;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::atomic<bool> stop{false};
+};
+
+fc_pool* fc_pool_create(int n_threads) {
+  auto* pool = new fc_pool();
+  if (n_threads < 1) n_threads = 1;
+  for (int i = 0; i < n_threads; ++i) {
+    pool->workers.emplace_back([pool] {
+      for (;;) {
+        std::function<void()> task;
+        {
+          std::unique_lock<std::mutex> lock(pool->mu);
+          pool->cv.wait(lock,
+                        [pool] { return pool->stop || !pool->tasks.empty(); });
+          if (pool->stop && pool->tasks.empty()) return;
+          task = std::move(pool->tasks.front());
+          pool->tasks.pop();
+        }
+        task();
+      }
+    });
+  }
+  return pool;
+}
+
+void fc_pool_destroy(fc_pool* pool) {
+  pool->stop = true;
+  pool->cv.notify_all();
+  for (auto& worker : pool->workers) worker.join();
+  delete pool;
+}
+
+struct fc_batch_item {
+  const uint8_t* data;
+  size_t len;
+  int scale_num;
+  uint8_t* out;
+  int width;
+  int height;
+};
+
+// Decode a batch of JPEGs in parallel on the pool; blocks until done.
+void fc_pool_decode_jpeg_batch(fc_pool* pool, fc_batch_item* items, int n) {
+  std::atomic<int> remaining{n};
+  std::mutex done_mu;
+  std::condition_variable done_cv;
+  for (int i = 0; i < n; ++i) {
+    fc_batch_item* item = &items[i];
+    {
+      std::lock_guard<std::mutex> lock(pool->mu);
+      pool->tasks.emplace([item, &remaining, &done_mu, &done_cv] {
+        item->out = fc_jpeg_decode(item->data, item->len, item->scale_num,
+                                   &item->width, &item->height);
+        if (remaining.fetch_sub(1) == 1) {
+          std::lock_guard<std::mutex> dl(done_mu);
+          done_cv.notify_all();
+        }
+      });
+    }
+    pool->cv.notify_one();
+  }
+  std::unique_lock<std::mutex> lock(done_mu);
+  done_cv.wait(lock, [&remaining] { return remaining.load() == 0; });
+}
+
+}  // extern "C"

@@ -1,0 +1,220 @@
+"""ctypes bindings for the native fastcodec library.
+
+Builds libfastcodec.so on demand (make, g++, links libjpeg/libwebp) and
+exposes decode/encode entry points with numpy in/out. All calls release the
+GIL (plain ctypes calls do), so the fc_pool batch decode genuinely runs
+decodes in parallel on multi-core hosts.
+
+Falls back cleanly: ``available()`` is False when the toolchain or libs are
+missing and callers (flyimg_tpu.codecs) keep using the PIL paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_DIR = os.path.join(os.path.dirname(__file__), "native")
+_LIB_PATH = os.path.join(_DIR, "libfastcodec.so")
+_lib = None
+_lib_lock = threading.Lock()
+
+
+class _BatchItem(ctypes.Structure):
+    _fields_ = [
+        ("data", ctypes.c_char_p),
+        ("len", ctypes.c_size_t),
+        ("scale_num", ctypes.c_int),
+        ("out", ctypes.c_void_p),
+        ("width", ctypes.c_int),
+        ("height", ctypes.c_int),
+    ]
+
+
+def _build() -> bool:
+    try:
+        proc = subprocess.run(
+            ["make", "-C", _DIR], capture_output=True, timeout=120
+        )
+        return proc.returncode == 0 and os.path.exists(_LIB_PATH)
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def _load():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH) and not _build():
+            _lib = False
+            return _lib
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            _lib = False
+            return _lib
+        lib.fc_jpeg_decode.restype = ctypes.c_void_p
+        lib.fc_jpeg_decode.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.fc_jpeg_encode.restype = ctypes.c_void_p
+        lib.fc_jpeg_encode.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
+        lib.fc_webp_decode.restype = ctypes.c_void_p
+        lib.fc_webp_decode.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.fc_webp_encode.restype = ctypes.c_void_p
+        lib.fc_webp_encode.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_float,
+            ctypes.c_int, ctypes.POINTER(ctypes.c_size_t),
+        ]
+        lib.fc_free.argtypes = [ctypes.c_void_p]
+        lib.fc_pool_create.restype = ctypes.c_void_p
+        lib.fc_pool_create.argtypes = [ctypes.c_int]
+        lib.fc_pool_destroy.argtypes = [ctypes.c_void_p]
+        lib.fc_pool_decode_jpeg_batch.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(_BatchItem), ctypes.c_int,
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return bool(_load())
+
+
+def _take_buffer(lib, ptr: int, nbytes: int) -> np.ndarray:
+    buf = ctypes.cast(ptr, ctypes.POINTER(ctypes.c_uint8 * nbytes)).contents
+    arr = np.frombuffer(buf, dtype=np.uint8).copy()
+    lib.fc_free(ptr)
+    return arr
+
+
+def jpeg_decode(
+    data: bytes, scale_num: int = 8
+) -> Optional[np.ndarray]:
+    """Decode JPEG -> [h, w, 3] uint8; scale_num/8 is the DCT scale."""
+    lib = _load()
+    if not lib:
+        return None
+    w = ctypes.c_int()
+    h = ctypes.c_int()
+    ptr = lib.fc_jpeg_decode(data, len(data), scale_num, ctypes.byref(w), ctypes.byref(h))
+    if not ptr:
+        return None
+    arr = _take_buffer(lib, ptr, w.value * h.value * 3)
+    return arr.reshape(h.value, w.value, 3)
+
+
+def jpeg_encode(
+    rgb: np.ndarray,
+    quality: int = 90,
+    *,
+    optimize: bool = True,
+    progressive: bool = True,
+    subsampling_444: bool = True,
+) -> Optional[bytes]:
+    lib = _load()
+    if not lib:
+        return None
+    rgb = np.ascontiguousarray(rgb, dtype=np.uint8)
+    h, w = rgb.shape[:2]
+    out_len = ctypes.c_size_t()
+    ptr = lib.fc_jpeg_encode(
+        rgb.tobytes(), w, h, int(quality), int(optimize), int(progressive),
+        0 if subsampling_444 else 2, ctypes.byref(out_len),
+    )
+    if not ptr:
+        return None
+    arr = _take_buffer(lib, ptr, out_len.value)
+    return arr.tobytes()
+
+
+def webp_decode(data: bytes) -> Optional[np.ndarray]:
+    lib = _load()
+    if not lib:
+        return None
+    w = ctypes.c_int()
+    h = ctypes.c_int()
+    ptr = lib.fc_webp_decode(data, len(data), ctypes.byref(w), ctypes.byref(h))
+    if not ptr:
+        return None
+    arr = _take_buffer(lib, ptr, w.value * h.value * 3)
+    return arr.reshape(h.value, w.value, 3)
+
+
+def webp_encode(
+    rgb: np.ndarray, quality: int = 90, lossless: bool = False
+) -> Optional[bytes]:
+    lib = _load()
+    if not lib:
+        return None
+    rgb = np.ascontiguousarray(rgb, dtype=np.uint8)
+    h, w = rgb.shape[:2]
+    out_len = ctypes.c_size_t()
+    ptr = lib.fc_webp_encode(
+        rgb.tobytes(), w, h, float(quality), int(lossless), ctypes.byref(out_len)
+    )
+    if not ptr:
+        return None
+    arr = _take_buffer(lib, ptr, out_len.value)
+    return arr.tobytes()
+
+
+class DecodePool:
+    """Parallel JPEG decode over the native worker pool."""
+
+    def __init__(self, n_threads: Optional[int] = None) -> None:
+        lib = _load()
+        if not lib:
+            raise RuntimeError("fastcodec unavailable")
+        self._lib = lib
+        self._pool = lib.fc_pool_create(n_threads or os.cpu_count() or 1)
+
+    def decode_batch(
+        self, blobs: List[bytes], scale_num: int = 8
+    ) -> List[Optional[np.ndarray]]:
+        n = len(blobs)
+        if n == 0:
+            return []
+        items = (_BatchItem * n)()
+        keepalive = []
+        for i, blob in enumerate(blobs):
+            buf = ctypes.create_string_buffer(blob, len(blob))
+            keepalive.append(buf)
+            items[i].data = ctypes.cast(buf, ctypes.c_char_p)
+            items[i].len = len(blob)
+            items[i].scale_num = scale_num
+        self._lib.fc_pool_decode_jpeg_batch(self._pool, items, n)
+        out: List[Optional[np.ndarray]] = []
+        for i in range(n):
+            if not items[i].out:
+                out.append(None)
+                continue
+            w, h = items[i].width, items[i].height
+            arr = _take_buffer(self._lib, items[i].out, w * h * 3)
+            out.append(arr.reshape(h, w, 3))
+        return out
+
+    def close(self) -> None:
+        if self._pool:
+            self._lib.fc_pool_destroy(self._pool)
+            self._pool = None
+
+    def __del__(self) -> None:  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass

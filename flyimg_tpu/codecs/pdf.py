@@ -1,0 +1,45 @@
+"""PDF rasterization (gated ingestion backend).
+
+Reference behavior: PDFs are rasterized by ImageMagick's ghostscript
+delegate with ``-density`` and a ``[page-1]`` selector (reference
+src/Core/Processor/ImageProcessor.php:70-72,80-84; Dockerfile:5 installs
+ghostscript). This image has no ghostscript, so the backend is gated the
+same way as video: present -> rasterize; absent -> UnsupportedMediaException.
+"""
+
+from __future__ import annotations
+
+import shutil
+import subprocess
+
+from flyimg_tpu.exceptions import ExecFailedException, UnsupportedMediaException
+
+GHOSTSCRIPT = shutil.which("gs")
+DEFAULT_DENSITY = 96  # IM's default PDF density is 72; flyimg exposes dnst_
+
+
+def ghostscript_available() -> bool:
+    return GHOSTSCRIPT is not None
+
+
+def rasterize_page(
+    pdf_path: str, out_path: str, page: int = 1, density: int | None = None
+) -> str:
+    """Rasterize one 1-indexed page to PNG at ``density`` dpi."""
+    if GHOSTSCRIPT is None:
+        raise UnsupportedMediaException(
+            "pdf sources need ghostscript, which is not available in this runtime"
+        )
+    dpi = int(density or DEFAULT_DENSITY)
+    page = max(int(page), 1)
+    cmd = [
+        GHOSTSCRIPT, "-dSAFER", "-dBATCH", "-dNOPAUSE", "-sDEVICE=png16m",
+        f"-r{dpi}", f"-dFirstPage={page}", f"-dLastPage={page}",
+        f"-sOutputFile={out_path}", pdf_path,
+    ]
+    proc = subprocess.run(cmd, capture_output=True, timeout=120)
+    if proc.returncode != 0:
+        raise ExecFailedException(
+            f"ghostscript failed (rc={proc.returncode}): {proc.stderr[-400:]!r}"
+        )
+    return out_path

@@ -1,0 +1,49 @@
+"""Video frame extraction (gated ingestion backend).
+
+Reference behavior: a video source is swapped for an ffmpeg-extracted frame
+at the ``tm_`` timestamp before the pipeline runs (reference
+src/Core/Entity/Image/InputImage.php:61-68,
+src/Core/Processor/VideoProcessor.php:35-57), frames cached per
+(source, time). This image has no ffmpeg binary, so the backend is gated:
+present -> same behavior; absent -> UnsupportedMediaException (the
+reference's Docker image bundles ffmpeg; we degrade explicitly instead).
+"""
+
+from __future__ import annotations
+
+import shutil
+import subprocess
+
+from flyimg_tpu.exceptions import ExecFailedException, UnsupportedMediaException
+
+FFMPEG = shutil.which("ffmpeg")
+
+
+def ffmpeg_available() -> bool:
+    return FFMPEG is not None
+
+
+def extract_frame(video_path: str, time_spec: str, out_path: str) -> str:
+    """Extract one frame at ``time_spec`` ('00:00:01' or seconds) to
+    ``out_path`` (jpg). Mirrors VideoProcessor.php:35-47's command shape."""
+    if FFMPEG is None:
+        raise UnsupportedMediaException(
+            "video sources need ffmpeg, which is not available in this runtime"
+        )
+    cmd = [
+        FFMPEG, "-y", "-i", video_path, "-ss", str(time_spec),
+        "-f", "image2", "-frames:v", "1", out_path,
+    ]
+    proc = subprocess.run(cmd, capture_output=True, timeout=120)
+    if proc.returncode != 0:
+        raise ExecFailedException(
+            f"ffmpeg failed (rc={proc.returncode}): {proc.stderr[-400:]!r}"
+        )
+    import os
+
+    if not os.path.exists(out_path) or os.path.getsize(out_path) == 0:
+        # timestamp past end of video (reference VideoProcessor.php:54-57)
+        raise ExecFailedException(
+            f"no frame extracted at {time_spec} (past end of video?)"
+        )
+    return out_path

@@ -1,0 +1,6 @@
+"""Saliency / detection models: vectorized smart-crop and face ops.
+
+Replaces the reference's python/smartcrop.py (pure-Python per-pixel scoring
+loops — its slowest path, see SURVEY.md section 3.4) and the OpenCV Haar
+``facedetect`` binary with JAX programs.
+"""

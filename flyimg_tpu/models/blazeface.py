@@ -1,0 +1,259 @@
+"""BlazeFace-style face detector in flax, with a sharded training step.
+
+The north-star face backend (BASELINE.json: "python/smartcrop.py's OpenCV
+Haar face-detect is replaced with a vmapped MediaPipe/BlazeFace JAX model").
+Architecture follows the BlazeFace recipe (single-shot anchor detector built
+from depthwise-separable "BlazeBlocks", two anchor scales at 16x16 and 8x8
+feature maps, 128x128 RGB input) — implemented from the paper's shape, not
+ported from any codebase.
+
+Serving: ``detect_faces(params, rgb)`` is vmap/jit-friendly and returns the
+same (x, y, w, h) box contract as models/facefind.py; a trained checkpoint
+can be dropped in via orbax. Training: ``make_train_step`` builds a
+jit-compiled step shardable over a (data, model) mesh — data parallelism
+shards the batch, tensor parallelism shards the widest conv channels —
+which is what __graft_entry__.dryrun_multichip exercises.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, Dict, List, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+INPUT_SIZE = 128
+ANCHORS_16 = 2   # anchors per cell on the 16x16 map
+ANCHORS_8 = 6    # anchors per cell on the 8x8 map
+NUM_ANCHORS = 16 * 16 * ANCHORS_16 + 8 * 8 * ANCHORS_8  # 896, as in the paper
+
+
+class BlazeBlock(nn.Module):
+    """Depthwise 5x5 + pointwise 1x1 with residual; optional stride-2."""
+
+    features: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(
+            x.shape[-1], (5, 5), strides=(self.stride, self.stride),
+            padding="SAME", feature_group_count=x.shape[-1], use_bias=False,
+        )(x)
+        y = nn.Conv(self.features, (1, 1), use_bias=True)(y)
+        if self.stride == 2:
+            residual = nn.max_pool(residual, (2, 2), strides=(2, 2))
+        if residual.shape[-1] != self.features:
+            pad = self.features - residual.shape[-1]
+            residual = jnp.pad(residual, ((0, 0), (0, 0), (0, 0), (0, pad)))
+        return nn.relu(y + residual)
+
+
+class BlazeFace(nn.Module):
+    """Backbone + dual-scale anchor heads (classification + box offsets)."""
+
+    @nn.compact
+    def __call__(self, x):
+        # x: [B, 128, 128, 3] float32 in [-1, 1]
+        x = nn.Conv(24, (5, 5), strides=(2, 2), padding="SAME")(x)  # 64x64
+        x = nn.relu(x)
+        x = BlazeBlock(24)(x)
+        x = BlazeBlock(28)(x)
+        x = BlazeBlock(32, stride=2)(x)    # 32x32
+        x = BlazeBlock(36)(x)
+        x = BlazeBlock(42)(x)
+        x = BlazeBlock(48, stride=2)(x)    # 16x16
+        x = BlazeBlock(56)(x)
+        x = BlazeBlock(64)(x)
+        x = BlazeBlock(72)(x)
+        x = BlazeBlock(80)(x)
+        x = BlazeBlock(88)(x)
+        x16 = x                             # [B, 16, 16, 88]
+        x = BlazeBlock(96, stride=2)(x16)  # 8x8
+        x = BlazeBlock(96)(x)
+        x = BlazeBlock(96)(x)
+        x = BlazeBlock(96)(x)
+        x8 = BlazeBlock(96)(x)             # [B, 8, 8, 96]
+
+        cls16 = nn.Conv(ANCHORS_16, (1, 1))(x16)       # [B,16,16,2]
+        reg16 = nn.Conv(ANCHORS_16 * 4, (1, 1))(x16)   # [B,16,16,8]
+        cls8 = nn.Conv(ANCHORS_8, (1, 1))(x8)          # [B,8,8,6]
+        reg8 = nn.Conv(ANCHORS_8 * 4, (1, 1))(x8)      # [B,8,8,24]
+
+        batch = x.shape[0]
+        scores = jnp.concatenate(
+            [cls16.reshape(batch, -1), cls8.reshape(batch, -1)], axis=1
+        )
+        boxes = jnp.concatenate(
+            [reg16.reshape(batch, -1, 4), reg8.reshape(batch, -1, 4)], axis=1
+        )
+        return scores, boxes  # [B, 896], [B, 896, 4]
+
+
+def anchor_centers() -> np.ndarray:
+    """[896, 4] anchors as (cx, cy, w, h) in [0,1] (uniform grid, unit-ish
+    scale per map, as in the BlazeFace anchor scheme)."""
+    anchors = []
+    for grid, count, scale in ((16, ANCHORS_16, 0.10), (8, ANCHORS_8, 0.30)):
+        for gy in range(grid):
+            for gx in range(grid):
+                cx = (gx + 0.5) / grid
+                cy = (gy + 0.5) / grid
+                for k in range(count):
+                    s = scale * (1.0 + 0.5 * k / max(count - 1, 1))
+                    anchors.append((cx, cy, s, s))
+    return np.asarray(anchors, dtype=np.float32)
+
+
+_ANCHORS = None
+
+
+def get_anchors() -> jnp.ndarray:
+    global _ANCHORS
+    if _ANCHORS is None:
+        _ANCHORS = jnp.asarray(anchor_centers())
+    return _ANCHORS
+
+
+def init_params(rng: jax.Array) -> Dict[str, Any]:
+    model = BlazeFace()
+    dummy = jnp.zeros((1, INPUT_SIZE, INPUT_SIZE, 3), jnp.float32)
+    return model.init(rng, dummy)
+
+
+def decode_boxes(raw: jnp.ndarray) -> jnp.ndarray:
+    """Anchor-relative offsets -> (cx, cy, w, h) in [0, 1]."""
+    anchors = get_anchors()
+    cx = anchors[:, 0] + raw[..., 0] * 0.1 * anchors[:, 2]
+    cy = anchors[:, 1] + raw[..., 1] * 0.1 * anchors[:, 3]
+    w = anchors[:, 2] * jnp.exp(jnp.clip(raw[..., 2] * 0.2, -4.0, 4.0))
+    h = anchors[:, 3] * jnp.exp(jnp.clip(raw[..., 3] * 0.2, -4.0, 4.0))
+    return jnp.stack([cx, cy, w, h], axis=-1)
+
+
+@partial(jax.jit, static_argnames=("score_threshold",))
+def _forward(params, images, score_threshold: float = 0.5):
+    scores, raw = BlazeFace().apply(params, images)
+    probs = jax.nn.sigmoid(scores)
+    boxes = decode_boxes(raw)
+    return probs, boxes
+
+
+def detect_faces(
+    params,
+    rgb: np.ndarray,
+    *,
+    score_threshold: float = 0.5,
+    max_faces: int = 16,
+) -> List[Tuple[int, int, int, int]]:
+    """[h, w, 3] uint8 -> list of (x, y, w, h) pixel boxes. Same contract as
+    facefind.detect_faces so the handler can swap backends."""
+    from PIL import Image
+
+    src_h, src_w = rgb.shape[:2]
+    resized = np.asarray(
+        Image.fromarray(rgb).resize((INPUT_SIZE, INPUT_SIZE), Image.BILINEAR),
+        dtype=np.float32,
+    )
+    inp = (resized / 127.5 - 1.0)[None]
+    probs, boxes = _forward(params, jnp.asarray(inp))
+    probs = np.asarray(probs[0])
+    boxes = np.asarray(boxes[0])
+    keep = np.argsort(-probs)[: max_faces * 4]
+    out: List[Tuple[int, int, int, int]] = []
+    taken: List[Tuple[float, float, float, float]] = []
+    for idx in keep:
+        if probs[idx] < score_threshold or len(out) >= max_faces:
+            break
+        cx, cy, w, h = boxes[idx]
+        cand = (cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2)
+        if any(_iou(cand, t) > 0.3 for t in taken):
+            continue
+        taken.append(cand)
+        x0 = int(max(cand[0], 0.0) * src_w)
+        y0 = int(max(cand[1], 0.0) * src_h)
+        x1 = int(min(cand[2], 1.0) * src_w)
+        y1 = int(min(cand[3], 1.0) * src_h)
+        if x1 > x0 and y1 > y0:
+            out.append((x0, y0, x1 - x0, y1 - y0))
+    return out
+
+
+def _iou(a, b) -> float:
+    ix = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+    iy = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+    inter = ix * iy
+    area_a = (a[2] - a[0]) * (a[3] - a[1])
+    area_b = (b[2] - b[0]) * (b[3] - b[1])
+    union = area_a + area_b - inter
+    return inter / union if union > 0 else 0.0
+
+
+# ---------------------------------------------------------------------------
+# training (exercised by __graft_entry__.dryrun_multichip on a fake mesh)
+# ---------------------------------------------------------------------------
+
+
+def loss_fn(params, images, target_probs, target_boxes, anchor_mask):
+    """Focal-ish BCE on anchor scores + smooth-L1 on positive anchor boxes."""
+    scores, raw = BlazeFace().apply(params, images)
+    probs = jax.nn.sigmoid(scores)
+    bce = -(
+        target_probs * jnp.log(probs + 1e-7)
+        + (1.0 - target_probs) * jnp.log(1.0 - probs + 1e-7)
+    )
+    focal = bce * (0.25 + 0.75 * target_probs)
+    cls_loss = jnp.mean(focal)
+
+    diff = raw - target_boxes
+    l1 = jnp.where(jnp.abs(diff) < 1.0, 0.5 * diff * diff, jnp.abs(diff) - 0.5)
+    reg_loss = jnp.sum(l1 * anchor_mask[..., None]) / (
+        jnp.sum(anchor_mask) * 4.0 + 1e-6
+    )
+    return cls_loss + reg_loss
+
+
+def make_train_step(optimizer: Optional[optax.GradientTransformation] = None):
+    optimizer = optimizer or optax.adam(1e-3)
+
+    def train_step(params, opt_state, images, target_probs, target_boxes, anchor_mask):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, images, target_probs, target_boxes, anchor_mask
+        )
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return optimizer, train_step
+
+
+def synthetic_batch(rng: np.random.Generator, batch: int):
+    """Synthetic training batch: colored ellipse "faces" on noise, with the
+    matching anchor targets — enough to drive a real optimization step (and
+    the multi-chip dryrun) without external data."""
+    anchors = np.asarray(anchor_centers())
+    images = rng.uniform(-1, 1, (batch, INPUT_SIZE, INPUT_SIZE, 3)).astype(np.float32)
+    target_probs = np.zeros((batch, NUM_ANCHORS), np.float32)
+    target_boxes = np.zeros((batch, NUM_ANCHORS, 4), np.float32)
+    mask = np.zeros((batch, NUM_ANCHORS), np.float32)
+    for i in range(batch):
+        cx, cy = rng.uniform(0.3, 0.7, 2)
+        size = rng.uniform(0.15, 0.4)
+        yy, xx = np.mgrid[0:INPUT_SIZE, 0:INPUT_SIZE] / INPUT_SIZE
+        ellipse = ((xx - cx) ** 2 + (yy - cy) ** 2) < (size / 2) ** 2
+        images[i][ellipse] = (0.56, 0.14, -0.12)  # skin-ish in [-1,1]
+        dist = np.abs(anchors[:, 0] - cx) + np.abs(anchors[:, 1] - cy)
+        pos = np.argsort(dist)[:8]
+        target_probs[i, pos] = 1.0
+        mask[i, pos] = 1.0
+        target_boxes[i, pos, 0] = (cx - anchors[pos, 0]) / (0.1 * anchors[pos, 2])
+        target_boxes[i, pos, 1] = (cy - anchors[pos, 1]) / (0.1 * anchors[pos, 3])
+        target_boxes[i, pos, 2] = np.log(size / anchors[pos, 2]) / 0.2
+        target_boxes[i, pos, 3] = np.log(size / anchors[pos, 3]) / 0.2
+    return images, target_probs, target_boxes, mask

@@ -1,0 +1,133 @@
+"""Face detection + face ops (blur / crop).
+
+The reference shells out to wavexx/facedetect (OpenCV Haar cascades) which
+prints one "x y w h" line per face (reference
+src/Core/Processor/FaceDetectProcessor.php:22-76). This framework keeps the
+same list-of-boxes contract with two interchangeable backends:
+
+- ``facefind`` (this module, default): a classical skin-region proposer —
+  skin-probability map (same normalized-rgb skin distance family as the
+  smart-crop scorer) computed on device, morphological cleanup via max/min
+  pooling, connected components + box extraction on host (scipy). No
+  weights needed, fully deterministic.
+- ``blazeface`` (models/blazeface.py): a BlazeFace-style convnet (the north
+  star per BASELINE.json) usable once a trained checkpoint is supplied;
+  same detect_faces() signature.
+
+Face blur reproduces the reference's pixelation (down/up-scale 10% region
+round trip, FaceDetectProcessor.php:51-76) via ops/pixelate.py in one fused
+program; face crop slices the Nth detected box (``fcp``,
+FaceDetectProcessor.php:22-42).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flyimg_tpu.ops.pixelate import pixelate_regions
+
+Box = Tuple[int, int, int, int]  # x, y, w, h
+
+MIN_FACE_FRACTION = 0.001  # reject blobs below 0.1% of image area
+MAX_FACES = 32
+
+
+@jax.jit
+def _skin_probability(rgb: jnp.ndarray) -> jnp.ndarray:
+    """[h, w, 3] uint8 -> [h, w] float32 skin likelihood in [0, 1].
+
+    Normalized-rgb chromaticity ellipse + simple RGB rules — the standard
+    classical skin segmentation recipe; no learned weights.
+    """
+    rgbf = rgb.astype(jnp.float32)
+    r, g, b = rgbf[..., 0], rgbf[..., 1], rgbf[..., 2]
+    total = r + g + b + 1e-6
+    rn, gn = r / total, g / total
+
+    # chromaticity gaussian centered on skin tones
+    d2 = ((rn - 0.44) / 0.07) ** 2 + ((gn - 0.31) / 0.05) ** 2
+    chroma = jnp.exp(-0.5 * d2)
+
+    # brightness + rule-based gates (skin is not too dark, r > b, r > g)
+    gates = (
+        (r > 60.0) & (r > b) & (r > g * 0.9) & (jnp.abs(r - g) > 10.0)
+    ).astype(jnp.float32)
+    return chroma * gates
+
+
+@jax.jit
+def _morph_clean(mask: jnp.ndarray) -> jnp.ndarray:
+    """Binary open+close via max/min pooling (device-friendly morphology)."""
+
+    def pool(m, op, k=5):
+        init = -jnp.inf if op is jax.lax.max else jnp.inf
+        return jax.lax.reduce_window(
+            m, init, op, (k, k), (1, 1), "SAME"
+        )
+
+    # erosion = -maxpool(-m); opening then closing with 5x5 windows
+    m = mask.astype(jnp.float32)
+    m = -pool(-m, jax.lax.max)          # erode
+    m = pool(m, jax.lax.max)            # dilate (open complete)
+    m = pool(m, jax.lax.max)            # dilate
+    m = -pool(-m, jax.lax.max)          # erode (close complete)
+    return m > 0.5
+
+
+def detect_faces(rgb: np.ndarray, threshold: float = 0.35) -> List[Box]:
+    """Detect face-like skin regions; boxes sorted left-to-right then
+    top-to-bottom (matching facedetect's reading order output, so ``fcp``
+    indices behave comparably)."""
+    from scipy import ndimage
+
+    prob = np.asarray(_skin_probability(jnp.asarray(rgb)))
+    mask = np.asarray(_morph_clean(jnp.asarray(prob > threshold)))
+    labels, count = ndimage.label(mask)
+    if count == 0:
+        return []
+    h, w = mask.shape
+    min_area = max(int(h * w * MIN_FACE_FRACTION), 16)
+    boxes: List[Box] = []
+    for sl in ndimage.find_objects(labels):
+        if sl is None:
+            continue
+        bh = sl[0].stop - sl[0].start
+        bw = sl[1].stop - sl[1].start
+        if bh * bw < min_area:
+            continue
+        # faces are roughly square-ish; reject extreme aspect blobs
+        aspect = bw / max(bh, 1)
+        if aspect < 0.25 or aspect > 4.0:
+            continue
+        boxes.append((sl[1].start, sl[0].start, bw, bh))
+    boxes.sort(key=lambda b: (b[1], b[0]))
+    return boxes[:MAX_FACES]
+
+
+def blur_faces(rgb: np.ndarray, boxes: List[Box]) -> np.ndarray:
+    """Pixelate every face region (reference blurFaces,
+    FaceDetectProcessor.php:51-76) in one device program."""
+    if not boxes:
+        return rgb
+    padded = np.zeros((MAX_FACES, 4), np.float32)
+    for i, box in enumerate(boxes[:MAX_FACES]):
+        padded[i] = box
+    out = pixelate_regions(
+        jnp.asarray(rgb, jnp.float32), jnp.asarray(padded)
+    )
+    return np.asarray(jnp.clip(jnp.round(out), 0, 255).astype(jnp.uint8))
+
+
+def crop_face(rgb: np.ndarray, boxes: List[Box], position: int = 0) -> np.ndarray:
+    """Crop the Nth face (reference cropFaces, FaceDetectProcessor.php:22-42;
+    silently returns the image unchanged when no face matches, mirroring the
+    reference's no-op on missing binary/face)."""
+    if not boxes:
+        return rgb
+    position = min(max(position, 0), len(boxes) - 1)
+    x, y, w, h = boxes[position]
+    return rgb[y : y + h, x : x + w]

@@ -8,7 +8,7 @@ whose hot paths (resampling) are expressed as einsums so they land on the MXU.
 
 from flyimg_tpu.ops.resample import resample_image, resample_matrix  # noqa: F401
 from flyimg_tpu.ops.filters import gaussian_blur, sharpen, unsharp_mask  # noqa: F401
-from flyimg_tpu.ops.color import to_grayscale, monochrome_dither, flatten_alpha  # noqa: F401
+from flyimg_tpu.ops.color import to_grayscale, monochrome_dither  # noqa: F401
 from flyimg_tpu.ops.rotate import rotate_image  # noqa: F401
 from flyimg_tpu.ops.pad import extent_pad  # noqa: F401
 from flyimg_tpu.ops.pixelate import pixelate_regions  # noqa: F401

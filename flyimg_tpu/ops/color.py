@@ -51,14 +51,3 @@ def monochrome_dither(image: jnp.ndarray) -> jnp.ndarray:
     threshold = (tile + 0.5) * (255.0 / 64.0)
     bw = jnp.where(luma > threshold, 255.0, 0.0)
     return jnp.broadcast_to(bw[..., None], image.shape).astype(image.dtype)
-
-
-def flatten_alpha(
-    rgba: jnp.ndarray, background: tuple = (255, 255, 255)
-) -> jnp.ndarray:
-    """Composite [..., H, W, 4] over a background color -> [..., H, W, 3].
-    (IM flattens alpha when encoding to JPEG; white is its default canvas.)"""
-    rgb = rgba[..., :3].astype(jnp.float32)
-    alpha = rgba[..., 3:4].astype(jnp.float32) / 255.0
-    bg = jnp.array(background, dtype=jnp.float32)
-    return rgb * alpha + bg * (1.0 - alpha)

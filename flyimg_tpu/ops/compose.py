@@ -96,17 +96,15 @@ def _needs_resample(plan: TransformPlan, layout: Layout) -> bool:
     )
 
 
-@lru_cache(maxsize=256)
-def build_program(
-    in_shape: Tuple[int, int],
+def make_program_fn(
     resample_out: Optional[Tuple[int, int]],
     pad_canvas: Optional[Tuple[int, int]],
     pad_offset: Tuple[int, int],
     plan: TransformPlan,
 ):
-    """Compile (lazily, via jit) the device program for one plan signature
-    at one padded input shape. Callers must pass ``plan.device_plan()`` so
-    the cache key ignores per-image geometry (it arrives as traced spans)."""
+    """The raw (unjitted) device program closure for one op config. Shared
+    by the single-image path (build_program jits it) and the batch runtime
+    (which vmaps it over a batch axis before jitting)."""
 
     def program(img_u8, in_true, span_y, span_x, out_true):
         x = img_u8.astype(jnp.float32)
@@ -134,7 +132,24 @@ def build_program(
             x = gaussian_blur(x, r, s)
         return jnp.clip(jnp.round(x), 0.0, 255.0).astype(jnp.uint8)
 
-    return jax.jit(program)
+    return program
+
+
+@lru_cache(maxsize=256)
+def build_program(
+    in_shape: Tuple[int, int],
+    resample_out: Optional[Tuple[int, int]],
+    pad_canvas: Optional[Tuple[int, int]],
+    pad_offset: Tuple[int, int],
+    plan: TransformPlan,
+):
+    """Compile (lazily, via jit) the device program for one op config at one
+    padded input shape. Callers must pass ``plan.device_plan()`` so the
+    cache key ignores per-image geometry (it arrives as traced spans).
+    ``in_shape`` keys the cache — the jit itself re-specializes per input
+    shape, but keeping it in the key keeps cache entries one-shape."""
+    del in_shape
+    return jax.jit(make_program_fn(resample_out, pad_canvas, pad_offset, plan))
 
 
 def _bucket_dim(size: int, step: int = 128) -> int:
@@ -150,15 +165,34 @@ def run_plan(image: np.ndarray, plan: TransformPlan) -> np.ndarray:
     """
     h, w = int(image.shape[0]), int(image.shape[1])
     if plan.src_size != (w, h):
-        plan = plan.with_src(w, h)
+        # geometry (pns clamping, fill dims, extract clamps) was resolved
+        # against plan.src_size; silently patching it here would run a stale
+        # plan. Callers must rebuild the plan for the actual decoded dims.
+        raise ValueError(
+            f"plan was built for src {plan.src_size}, got image {(w, h)}; "
+            "rebuild the plan with build_plan(options, w, h)"
+        )
     layout = plan_layout(plan)
 
+    slice_out = None
     if _needs_resample(plan, layout):
         bh, bw = _bucket_dim(h), _bucket_dim(w)
         padded = np.zeros((bh, bw, image.shape[2]), dtype=np.uint8)
         padded[:h, :w] = image
         resample_out = layout.resample_out
         in_shape = (bh, bw)
+    elif plan.rotate is None:
+        # pixel-op-only plans also ride shape buckets (otherwise every
+        # distinct source resolution would force a fresh XLA compile).
+        # Edge-replicate padding keeps convolutional ops correct at the
+        # valid-region boundary (== IM's edge virtual-pixel policy); the
+        # valid region is sliced back out below. Rotate is excluded: its
+        # output bbox is derived from the full (padded) frame.
+        bh, bw = _bucket_dim(h), _bucket_dim(w)
+        padded = np.pad(image, ((0, bh - h), (0, bw - w), (0, 0)), mode="edge")
+        resample_out = None
+        in_shape = (bh, bw)
+        slice_out = (h, w)
     else:
         padded = image
         resample_out = None
@@ -178,4 +212,7 @@ def run_plan(image: np.ndarray, plan: TransformPlan) -> np.ndarray:
         jnp.array(layout.span_x, jnp.float32),
         jnp.array(layout.out_true, jnp.float32),
     )
-    return np.asarray(out)
+    result = np.asarray(out)
+    if slice_out is not None:
+        result = np.ascontiguousarray(result[: slice_out[0], : slice_out[1]])
+    return result

@@ -1,0 +1,21 @@
+"""Parallelism: device meshes, batch sharding, spatial tiling, multi-host.
+
+The reference's only scale-out story is share-nothing containers behind a
+load balancer (SURVEY.md section 2.4). The TPU framework's equivalents:
+
+- data parallelism: the request batch axis sharded over the mesh's "data"
+  axis (serving) — pure SPMD fan-out, no collectives needed for inference;
+- tensor parallelism: detector-model channels sharded over "model"
+  (training, see models/blazeface.py + __graft_entry__);
+- spatial (sequence/context-parallel analog): very large images H-sharded
+  across devices with halo exchange via ppermute (parallel/tiling.py) —
+  needed for the 4k firehose config (BASELINE.json configs[4]);
+- multi-host: jax.distributed over DCN (parallel/dist.py).
+"""
+
+from flyimg_tpu.parallel.mesh import (  # noqa: F401
+    batch_sharding,
+    default_mesh,
+    make_mesh,
+)
+from flyimg_tpu.parallel.tiling import tiled_transform  # noqa: F401

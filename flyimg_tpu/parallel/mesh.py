@@ -1,0 +1,41 @@
+"""Device mesh construction + standard shardings."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    axis_sizes: Optional[Tuple[int, ...]] = None,
+    axis_names: Sequence[str] = ("data",),
+    devices=None,
+) -> Mesh:
+    """Build a Mesh over the available devices. Default: all devices on one
+    'data' axis (serving = SPMD fan-out over the batch)."""
+    devices = list(devices if devices is not None else jax.devices())
+    if axis_sizes is None:
+        axis_sizes = (len(devices),)
+    n = int(np.prod(axis_sizes))
+    if n > len(devices):
+        raise ValueError(
+            f"mesh wants {n} devices, only {len(devices)} available"
+        )
+    grid = np.asarray(devices[:n]).reshape(axis_sizes)
+    return Mesh(grid, axis_names)
+
+
+def default_mesh() -> Mesh:
+    return make_mesh()
+
+
+def batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    """Shard the leading (batch) axis over ``axis``, replicate the rest."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,119 @@
+"""Spatial tiling: H-sharded image transforms with halo exchange.
+
+The image-domain analog of ring/context parallelism (SURVEY.md section 5
+"long-context"): a very large image (4k+) is sharded across devices along
+its height; each device resamples its slice of the OUTPUT rows, for which it
+needs its input tile plus ``halo`` boundary rows from each neighbor —
+exchanged with ``jax.lax.ppermute`` over the mesh axis, so the traffic rides
+ICI exactly like a ring-attention block transfer.
+
+Used for the "4k -> 256 thumbnail firehose" config (BASELINE.json
+configs[4]) where a single image's resample is worth splitting across the
+pod; the serving batch path (runtime/batcher.py) stays pure data-parallel.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from flyimg_tpu.ops.resample import resample_matrix
+
+
+def _halo_exchange(tile: jnp.ndarray, halo: int, axis_name: str) -> jnp.ndarray:
+    """Concatenate ``halo`` rows from the previous/next device around the
+    local tile. Edge devices receive zeros (masked out of the weights)."""
+    n = jax.lax.axis_size(axis_name)
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+    bwd = [(i, (i - 1) % n) for i in range(n)]
+    # my bottom rows -> next device's top halo; my top rows -> prev's bottom
+    from_prev = jax.lax.ppermute(tile[-halo:], axis_name, fwd)
+    from_next = jax.lax.ppermute(tile[:halo], axis_name, bwd)
+    idx = jax.lax.axis_index(axis_name)
+    # zero the wrapped halos at the edges of the image
+    from_prev = jnp.where(idx == 0, jnp.zeros_like(from_prev), from_prev)
+    from_next = jnp.where(idx == n - 1, jnp.zeros_like(from_next), from_next)
+    return jnp.concatenate([from_prev, tile, from_next], axis=0)
+
+
+def tiled_transform(
+    image: jnp.ndarray,
+    out_hw: Tuple[int, int],
+    mesh: Mesh,
+    *,
+    axis: str = "sp",
+    method: str = "lanczos3",
+) -> jnp.ndarray:
+    """Resize [H, W, 3] -> [out_h, out_w, 3] with H sharded over
+    ``mesh[axis]``. H and out_h must divide the axis size.
+
+    Per-device work: resample the full width axis locally (replicated W),
+    and the height axis from (local tile + halos) with a weight matrix whose
+    sample coordinates are offset by the device's global tile position —
+    ppermute is the only cross-device communication.
+    """
+    n = mesh.shape[axis]
+    in_h, in_w = int(image.shape[0]), int(image.shape[1])
+    out_h, out_w = out_hw
+    if in_h % n or out_h % n:
+        raise ValueError(f"H={in_h} and out_h={out_h} must divide mesh axis {n}")
+    tile_h = in_h // n
+    out_tile_h = out_h // n
+    # source rows any output row needs: kernel support * downscale ratio
+    scale_y = max(in_h / out_h, 1.0)
+    halo = min(int(3.0 * scale_y) + 2, tile_h)
+
+    def kernel(tile):  # [tile_h, W, 3] on each device
+        idx = jax.lax.axis_index(axis)
+        padded = _halo_exchange(tile, halo, axis)  # [tile_h + 2*halo, W, 3]
+        local_rows = tile_h + 2 * halo
+        # global source span of MY output rows, expressed in local coords:
+        # out row r (global r0 = idx*out_tile_h) samples global source
+        # y = (r + .5) * in_h/out_h - .5; local y = y - (idx*tile_h - halo)
+        row_scale = in_h / out_h
+        global_start = idx * out_tile_h * row_scale
+        local_offset = idx * tile_h - halo
+        span_start = global_start - local_offset
+        span_size = out_tile_h * row_scale
+        # valid local rows: [halo, halo+tile_h) plus real halo rows where the
+        # neighbor exists; weight masking uses in_true rows from the top
+        top_valid = jnp.where(idx == 0, halo, 0)
+        bottom_valid = jnp.where(
+            idx == jax.lax.axis_size(axis) - 1, local_rows - halo, local_rows
+        )
+        wy = resample_matrix(
+            local_rows, out_tile_h,
+            span_start, span_size,
+            jnp.float32(out_tile_h), jnp.float32(bottom_valid),
+            method,
+        )
+        # also zero taps above top_valid (edge devices' wrapped halo)
+        j = jnp.arange(local_rows, dtype=jnp.float32)
+        wy = jnp.where(j[None, :] >= top_valid, wy, 0.0)
+        denom = jnp.sum(wy, axis=-1, keepdims=True)
+        wy = wy / jnp.where(denom == 0.0, 1.0, denom)
+        wx = resample_matrix(
+            in_w, out_w,
+            jnp.float32(0.0), jnp.float32(in_w),
+            jnp.float32(out_w), jnp.float32(in_w),
+            method,
+        )
+        tmp = jnp.einsum(
+            "oh,hwc->owc", wy, padded.astype(jnp.float32),
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        return jnp.einsum(
+            "ow,hwc->hoc", wx, tmp, precision=jax.lax.Precision.HIGHEST,
+        )
+
+    sharded = jax.shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=P(axis, None, None),
+        out_specs=P(axis, None, None),
+    )
+    return sharded(image.astype(jnp.float32))

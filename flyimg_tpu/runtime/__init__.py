@@ -1,0 +1,10 @@
+"""Batch runtime: the BatchController and device executor.
+
+This is the execution-model inversion at the heart of the framework: the
+reference runs "one process per image per op" (exec of convert per request,
+reference src/Core/Processor/Processor.php:44-62); here concurrent requests
+sharing a plan signature are collected into padded device batches and run as
+ONE vmapped XLA program per flush (SURVEY.md section 7 phase 2).
+"""
+
+from flyimg_tpu.runtime.batcher import BatchController  # noqa: F401

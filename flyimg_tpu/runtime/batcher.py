@@ -1,0 +1,332 @@
+"""BatchController: dynamic batching of concurrent transform requests.
+
+Requests are grouped by their device-program identity — the same key the
+compile cache uses: (input bucket shape, static resample output, pad config,
+``plan.device_plan()``). Every member of a group differs only in pixels and
+traced geometry scalars, so a group executes as ONE jitted vmapped program:
+
+    uint8 [B, Hb, Wb, 3] + per-image spans/true-sizes -> uint8 [B, Ho, Wo, 3]
+
+Flush policy (reference-free; this subsystem has no analog in the
+per-request reference): a group flushes when it reaches ``max_batch`` or
+when its oldest member has waited ``deadline_ms`` — the standard
+throughput/latency dial for dynamic batching. Batch sizes are bucketed to
+powers of two (padding repeats the last image) so XLA compiles a handful of
+batch shapes per program, not one per occupancy.
+
+A single executor thread owns the device: groups run serially (the chip is
+serial anyway), submissions return futures usable from threads or asyncio.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from functools import lru_cache
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flyimg_tpu.ops.compose import (
+    _bucket_dim,
+    make_program_fn,
+    plan_layout,
+)
+from flyimg_tpu.spec.plan import TransformPlan
+
+BATCH_SIZE_LADDER = (1, 2, 4, 8, 16, 32, 64)
+
+
+def _round_batch(n: int) -> int:
+    for size in BATCH_SIZE_LADDER:
+        if n <= size:
+            return size
+    return BATCH_SIZE_LADDER[-1]
+
+
+@lru_cache(maxsize=256)
+def build_batched_program(
+    batch_size: int,
+    in_shape: Tuple[int, int],
+    resample_out: Optional[Tuple[int, int]],
+    pad_canvas: Optional[Tuple[int, int]],
+    pad_offset: Tuple[int, int],
+    plan: TransformPlan,
+):
+    """vmap of the single-image program over a static batch axis."""
+    del batch_size, in_shape  # cache-key components; jit re-specializes
+    inner = make_program_fn(resample_out, pad_canvas, pad_offset, plan)
+    return jax.jit(jax.vmap(inner))
+
+
+@dataclass
+class _Pending:
+    image: np.ndarray               # [h, w, 3] uint8
+    plan: TransformPlan
+    future: Future
+    enqueued_at: float
+    out_true: Tuple[int, int]       # (h, w) valid output extent
+    needs_slice: bool = False       # output was bucket-padded; slice out_true
+
+
+@dataclass
+class _Group:
+    key: Tuple
+    in_shape: Tuple[int, int]
+    resample_out: Optional[Tuple[int, int]]
+    pad_canvas: Optional[Tuple[int, int]]
+    pad_offset: Tuple[int, int]
+    device_plan: TransformPlan
+    members: List[_Pending] = field(default_factory=list)
+
+
+class BatchController:
+    """Thread-safe dynamic batcher in front of the device."""
+
+    def __init__(
+        self,
+        *,
+        max_batch: int = 64,
+        deadline_ms: float = 4.0,
+    ) -> None:
+        self.max_batch = max_batch
+        self.deadline_s = deadline_ms / 1000.0
+        self._groups: Dict[Tuple, _Group] = {}
+        self._lock = threading.Condition()
+        self._stop = False
+        self._stats = {"batches": 0, "images": 0, "occupancy_sum": 0.0}
+        self._thread = threading.Thread(
+            target=self._run, name="flyimg-batcher", daemon=True
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------------
+
+    def submit(self, image: np.ndarray, plan: TransformPlan) -> Future:
+        """Queue one image+plan; resolves to the uint8 output array."""
+        h, w = int(image.shape[0]), int(image.shape[1])
+        if plan.src_size != (w, h):
+            raise ValueError("plan src_size does not match image dims")
+        layout = plan_layout(plan)
+        needs_resample = (
+            plan.resize_to is not None
+            or plan.extent is not None
+            or plan.extract is not None
+        )
+        needs_slice = False
+        if needs_resample:
+            in_shape = (_bucket_dim(h), _bucket_dim(w))
+            if plan.extent is not None or plan.rotate is not None:
+                # crop path: every member lands on the identical extent.
+                # rotate: output geometry is position-sensitive (bucket
+                # padding would rotate garbage into the frame) — keep exact.
+                resample_out = layout.resample_out
+            else:
+                # fit path: output height varies with source aspect; bucket
+                # the static output so mixed-aspect members share one
+                # program (the valid region is sliced per member below).
+                # Padding rows replicate the edge row (clamped sampling), so
+                # convolutional post-ops see 'edge' padding — benign.
+                resample_out = (
+                    _bucket_dim(layout.resample_out[0], 64),
+                    _bucket_dim(layout.resample_out[1], 64),
+                )
+                needs_slice = resample_out != layout.resample_out
+        elif plan.rotate is None:
+            # pixel-op-only plans ride input buckets too (edge-replicate
+            # fill in _execute keeps convolutional ops correct); the valid
+            # region is sliced per member. Same policy as ops/compose.py.
+            in_shape = (_bucket_dim(h), _bucket_dim(w))
+            resample_out = None
+            needs_slice = in_shape != (h, w)
+        else:
+            in_shape = (h, w)
+            resample_out = None
+        device_plan = plan.device_plan()
+        key = (
+            in_shape, resample_out, layout.pad_canvas, layout.pad_offset,
+            device_plan,
+        )
+        future: Future = Future()
+        pending = _Pending(
+            image=image,
+            plan=plan,
+            future=future,
+            enqueued_at=time.monotonic(),
+            out_true=layout.out_true,
+            needs_slice=needs_slice,
+        )
+        with self._lock:
+            group = self._groups.get(key)
+            if group is None:
+                group = _Group(
+                    key=key,
+                    in_shape=in_shape,
+                    resample_out=resample_out,
+                    pad_canvas=layout.pad_canvas,
+                    pad_offset=layout.pad_offset,
+                    device_plan=device_plan,
+                )
+                self._groups[key] = group
+            group.members.append(pending)
+            self._lock.notify()
+        return future
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            stats = dict(self._stats)
+        batches = max(stats["batches"], 1)
+        stats["mean_occupancy"] = stats["occupancy_sum"] / batches
+        return stats
+
+    def close(self) -> None:
+        with self._lock:
+            self._stop = True
+            self._lock.notify_all()
+        self._thread.join(timeout=5)
+
+    # ------------------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            group = None
+            with self._lock:
+                while not self._stop and not self._ready_group():
+                    # wake at the earliest deadline among queued members
+                    timeout = self._next_deadline()
+                    self._lock.wait(timeout=timeout)
+                if self._stop and not any(
+                    g.members for g in self._groups.values()
+                ):
+                    return
+                group = self._pop_ready_group()
+            if group is not None:
+                self._execute(group)
+
+    def _ready_group(self) -> bool:
+        now = time.monotonic()
+        for group in self._groups.values():
+            if not group.members:
+                continue
+            if len(group.members) >= self.max_batch:
+                return True
+            if now - group.members[0].enqueued_at >= self.deadline_s:
+                return True
+        return False
+
+    def _next_deadline(self) -> Optional[float]:
+        now = time.monotonic()
+        deadlines = [
+            group.members[0].enqueued_at + self.deadline_s - now
+            for group in self._groups.values()
+            if group.members
+        ]
+        if not deadlines:
+            return None
+        return max(min(deadlines), 0.0)
+
+    def _pop_ready_group(self) -> Optional[_Group]:
+        now = time.monotonic()
+        best = None
+        best_score = None
+        for key, group in list(self._groups.items()):
+            if not group.members:
+                self._groups.pop(key, None)
+                continue
+            full = len(group.members) >= self.max_batch
+            expired = now - group.members[0].enqueued_at >= self.deadline_s
+            if not (full or expired):
+                continue
+            score = (1 if full else 0, len(group.members))
+            if best_score is None or score > best_score:
+                best, best_score = key, score
+        if best is None:
+            return None
+        group = self._groups[best]
+        take = group.members[: self.max_batch]
+        group.members = group.members[self.max_batch :]
+        if not group.members:
+            self._groups.pop(best, None)
+        ready = _Group(
+            key=group.key,
+            in_shape=group.in_shape,
+            resample_out=group.resample_out,
+            pad_canvas=group.pad_canvas,
+            pad_offset=group.pad_offset,
+            device_plan=group.device_plan,
+            members=take,
+        )
+        return ready
+
+    # ------------------------------------------------------------------
+
+    def _execute(self, group: _Group) -> None:
+        members = group.members
+        n = len(members)
+        batch = _round_batch(n)
+        try:
+            bh, bw = group.in_shape
+            images = np.zeros((batch, bh, bw, 3), dtype=np.uint8)
+            in_true = np.zeros((batch, 2), dtype=np.float32)
+            span_y = np.zeros((batch, 2), dtype=np.float32)
+            span_x = np.zeros((batch, 2), dtype=np.float32)
+            out_true = np.zeros((batch, 2), dtype=np.float32)
+            for i, member in enumerate(members):
+                h, w = member.image.shape[:2]
+                if group.resample_out is None and (h, w) != (bh, bw):
+                    # pixel-op-only bucket: edge-replicate so convs stay
+                    # correct at the valid-region boundary
+                    images[i] = np.pad(
+                        member.image,
+                        ((0, bh - h), (0, bw - w), (0, 0)),
+                        mode="edge",
+                    )
+                else:
+                    images[i, :h, :w] = member.image
+                layout = plan_layout(member.plan)
+                in_true[i] = (h, w)
+                span_y[i] = layout.span_y
+                span_x[i] = layout.span_x
+                out_true[i] = layout.out_true
+            for i in range(n, batch):  # pad slots repeat the last member
+                images[i] = images[n - 1]
+                in_true[i] = in_true[n - 1]
+                span_y[i] = span_y[n - 1]
+                span_x[i] = span_x[n - 1]
+                out_true[i] = out_true[n - 1]
+
+            fn = build_batched_program(
+                batch,
+                group.in_shape,
+                group.resample_out,
+                group.pad_canvas,
+                group.pad_offset,
+                group.device_plan,
+            )
+            out = np.asarray(
+                fn(
+                    jnp.asarray(images),
+                    jnp.asarray(in_true),
+                    jnp.asarray(span_y),
+                    jnp.asarray(span_x),
+                    jnp.asarray(out_true),
+                )
+            )
+            with self._lock:
+                self._stats["batches"] += 1
+                self._stats["images"] += n
+                self._stats["occupancy_sum"] += n / batch
+            for i, member in enumerate(members):
+                result = out[i]
+                if member.needs_slice:
+                    th, tw = member.out_true
+                    result = result[: int(th), : int(tw)]
+                member.future.set_result(np.ascontiguousarray(result))
+        except Exception as exc:  # pragma: no cover - defensive
+            for member in members:
+                if not member.future.done():
+                    member.future.set_exception(exc)

@@ -1,0 +1,6 @@
+"""HTTP service tier: routes, orchestration, security, responses.
+
+The reference's L1-L3 (bootstrap, routing, ImageHandler/SecurityHandler —
+SURVEY.md section 1) re-done as an asyncio service in front of the batched
+device runtime.
+"""

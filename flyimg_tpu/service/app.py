@@ -1,0 +1,169 @@
+"""HTTP application: routes, error mapping, CLI.
+
+The reference's L1/L2 (Silex bootstrap + routes, reference app.php,
+config/routes.yml, src/Core/Controller/DefaultController.php) as an aiohttp
+app. Routes preserved exactly:
+
+    GET /                                   -> demo homepage
+    GET /upload/{options}/{imageSrc:.+}     -> transformed image bytes
+    GET /path/{options}/{imageSrc:.+}       -> public URL of the stored file
+
+plus the ``encrypt`` CLI subcommand (reference app.php:93-96):
+
+    python -m flyimg_tpu.service.app encrypt '<options>/<url>'
+    python -m flyimg_tpu.service.app serve --port 8080 [--params file.yml]
+
+The per-request transform runs in a worker executor so the event loop keeps
+accepting requests while decode/device/encode are busy; batched device
+execution is handled underneath by the runtime (flyimg_tpu/runtime).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+from typing import Optional
+
+from aiohttp import web
+
+from flyimg_tpu.appconfig import AppParameters
+from flyimg_tpu.exceptions import (
+    AppException,
+    ExecFailedException,
+    InvalidArgumentException,
+    ReadFileException,
+    SecurityException,
+    UnsupportedMediaException,
+)
+from flyimg_tpu.service.handler import ImageHandler
+from flyimg_tpu.service.response import image_headers
+from flyimg_tpu.storage import make_storage
+
+_ERROR_STATUS = {
+    SecurityException: 403,
+    ReadFileException: 404,
+    InvalidArgumentException: 400,
+    UnsupportedMediaException: 415,
+    ExecFailedException: 500,
+}
+
+HOMEPAGE = """<!doctype html>
+<html><head><title>flyimg-tpu</title></head>
+<body style="font-family: sans-serif; max-width: 42em; margin: 3em auto">
+<h1>flyimg-tpu</h1>
+<p>TPU-native on-the-fly image resizing, cropping and compression.</p>
+<p>Usage: <code>GET /upload/{options}/{image-url}</code> — e.g.
+<code>/upload/w_300,h_250,c_1/https://example.com/image.jpg</code></p>
+<p>Options: w, h, c, g (gravity), r (rotate), q (quality), o (output:
+auto/input/jpg/png/webp/gif), smc (smart crop), fc/fb (face crop/blur),
+blr/sh/unsh, bg, clsp, mnchr, e+p1x..p2y (extract), ett, rz, pns, par,
+webpl, gf, pg, tm, dnst, rf (refresh) — flyimg-compatible URL grammar.</p>
+</body></html>"""
+
+
+def make_app(params: Optional[AppParameters] = None) -> web.Application:
+    params = params or AppParameters()
+    storage = make_storage(params)
+    from flyimg_tpu.runtime import BatchController
+
+    batcher = BatchController(
+        max_batch=int(params.by_key("batch_max_size", 64)),
+        deadline_ms=float(params.by_key("batch_deadline_ms", 4.0)),
+    )
+    handler = ImageHandler(storage, params, batcher=batcher)
+
+    app = web.Application(client_max_size=64 * 1024 * 1024)
+    app["params"] = params
+    app["handler"] = handler
+
+    async def _close_batcher(_app):
+        batcher.close()
+
+    app.on_cleanup.append(_close_batcher)
+
+    def _accepts_webp(request: web.Request) -> bool:
+        return "image/webp" in request.headers.get("Accept", "")
+
+    async def _process(request: web.Request):
+        options = request.match_info["options"]
+        image_src = request.match_info["imageSrc"]
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None,
+            lambda: handler.process_image(
+                options, image_src, accepts_webp=_accepts_webp(request)
+            ),
+        )
+
+    async def index(_request: web.Request) -> web.Response:
+        return web.Response(text=HOMEPAGE, content_type="text/html")
+
+    async def upload(request: web.Request) -> web.Response:
+        try:
+            result = await _process(request)
+        except AppException as exc:
+            return _error_response(exc)
+        headers = image_headers(
+            result, params.by_key("header_cache_days", 365)
+        )
+        return web.Response(body=result.content, headers=headers)
+
+    async def path(request: web.Request) -> web.Response:
+        try:
+            result = await _process(request)
+        except AppException as exc:
+            return _error_response(exc)
+        base = f"{request.scheme}://{request.host}"
+        url = storage.public_url(result.spec.name, base)
+        return web.Response(text=url)
+
+    app.router.add_get("/", index)
+    # imageSrc uses a catch-all pattern so full URLs (with slashes) work as
+    # path parameters — the reference's `imageSrc: .+` route requirement
+    # (config/routes.yml:9,14)
+    app.router.add_get("/upload/{options}/{imageSrc:.+}", upload)
+    app.router.add_get("/path/{options}/{imageSrc:.+}", path)
+    return app
+
+
+def _error_response(exc: AppException) -> web.Response:
+    status = 500
+    for cls, code in _ERROR_STATUS.items():
+        if isinstance(exc, cls):
+            status = code
+            break
+    return web.Response(status=status, text=f"{type(exc).__name__}: {exc}")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="flyimg-tpu")
+    sub = parser.add_subparsers(dest="cmd")
+    enc = sub.add_parser("encrypt", help="mint a signed URL token")
+    enc.add_argument("payload", help="'{options}/{imageSrc}' to encrypt")
+    enc.add_argument("--params", default=None)
+    srv = sub.add_parser("serve", help="run the HTTP service")
+    srv.add_argument("--host", default="0.0.0.0")
+    srv.add_argument("--port", type=int, default=8080)
+    srv.add_argument("--params", default=None)
+    args = parser.parse_args(argv)
+
+    params = (
+        AppParameters.from_yaml(args.params)
+        if getattr(args, "params", None)
+        else AppParameters()
+    )
+    if args.cmd == "encrypt":
+        from flyimg_tpu.service.security import SecurityHandler
+
+        print(SecurityHandler(params).encrypt(args.payload))
+        return 0
+    if args.cmd == "serve":
+        web.run_app(make_app(params), host=args.host, port=args.port)
+        return 0
+    parser.print_help()
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

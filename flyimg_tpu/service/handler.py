@@ -1,0 +1,262 @@
+"""ImageHandler: the orchestration choke point.
+
+Port of the reference's pipeline driver (src/Core/Handler/ImageHandler.php):
+security checks -> options parse -> source fetch/ingest -> output naming +
+cache check -> transform -> post-passes (smart-crop, face blur, face crop,
+same order and GIF exclusions as ImageHandler.php:160-181,125-152) ->
+store -> serve bytes.
+
+The transform itself is the TPU pipeline: decode (with DCT prescale hint)
+-> device program (ops/compose.py) -> host encode. Animated GIF outputs
+run the device program per frame and re-assemble, replacing the reference's
+`-coalesce` whole-animation convert (ImageProcessor.php:74-76).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+import numpy as np
+
+from flyimg_tpu.appconfig import AppParameters
+from flyimg_tpu.codecs import decode, encode
+from flyimg_tpu.exceptions import AppException
+from flyimg_tpu.ops.compose import run_plan
+from flyimg_tpu.service.input_source import load_source
+from flyimg_tpu.service.output_image import OutputSpec, resolve_output
+from flyimg_tpu.service.security import SecurityHandler
+from flyimg_tpu.spec.options import OptionsBag
+from flyimg_tpu.spec.plan import TransformPlan, build_plan
+from flyimg_tpu.storage.base import Storage
+
+
+@dataclass
+class ProcessedImage:
+    """What a request resolves to (the reference's OutputImage after
+    attachOutputContent)."""
+
+    content: bytes
+    spec: OutputSpec
+    options: OptionsBag
+    from_cache: bool = False
+    timings: Dict[str, float] = field(default_factory=dict)
+
+
+class ImageHandler:
+    def __init__(
+        self,
+        storage: Storage,
+        params: AppParameters,
+        *,
+        batcher=None,
+        face_backend=None,
+        smartcrop_backend=None,
+    ) -> None:
+        self.storage = storage
+        self.params = params
+        self.security = SecurityHandler(params)
+        self.batcher = batcher  # BatchController; None = direct device calls
+        self._face_backend = face_backend
+        self._smartcrop_backend = smartcrop_backend
+
+    # lazily import model backends so the service can run without them
+    def _smartcrop(self):
+        if self._smartcrop_backend is None:
+            from flyimg_tpu.models import smartcrop
+
+            self._smartcrop_backend = smartcrop
+        return self._smartcrop_backend
+
+    def _faces(self):
+        if self._face_backend is None:
+            from flyimg_tpu.models import facefind
+
+            self._face_backend = facefind
+        return self._face_backend
+
+    def process_image(
+        self,
+        options_str: str,
+        image_src: str,
+        *,
+        accepts_webp: bool = False,
+    ) -> ProcessedImage:
+        """The single choke point every image request goes through
+        (reference ImageHandler::processImage, ImageHandler.php:92-118)."""
+        timings: Dict[str, float] = {}
+        t0 = time.perf_counter()
+
+        options_str, image_src = self.security.check_security_hash(
+            options_str, image_src
+        )
+        self.security.check_restricted_domains(image_src)
+
+        options = OptionsBag(
+            options_str,
+            options_keys=self.params.by_key("options_keys"),
+            default_options=self.params.by_key("default_options"),
+            separator=self.params.by_key("options_separator", ","),
+        )
+
+        source = load_source(
+            image_src,
+            options,
+            self.params.by_key("tmp_dir", "var/tmp"),
+            header_extra_options=self.params.by_key("header_extra_options", ""),
+        )
+        timings["fetch"] = time.perf_counter() - t0
+
+        spec = resolve_output(
+            options, image_src, source.info.mime, accepts_webp=accepts_webp
+        )
+
+        refresh = bool(options.get("refresh")) and str(options.get("refresh")) == "1"
+        if refresh and self.storage.has(spec.name):
+            self.storage.delete(spec.name)
+
+        if self.storage.has(spec.name):
+            return ProcessedImage(
+                content=self.storage.read(spec.name),
+                spec=spec,
+                options=options,
+                from_cache=True,
+                timings=timings,
+            )
+
+        content = self._process_new(source.data, options, spec, timings)
+        self.storage.write(spec.name, content)
+        timings["total"] = time.perf_counter() - t0
+        return ProcessedImage(
+            content=content, spec=spec, options=options, timings=timings
+        )
+
+    # ------------------------------------------------------------------
+
+    def _process_new(
+        self,
+        data: bytes,
+        options: OptionsBag,
+        spec: OutputSpec,
+        timings: Dict[str, float],
+    ) -> bytes:
+        """Transform pipeline on a cache miss (reference
+        ImageHandler::processNewImage, ImageHandler.php:160-181)."""
+        t = time.perf_counter()
+
+        is_animated_gif_out = spec.is_gif
+        # decode target hint for JPEG DCT prescale: the requested box
+        tw = options.int_option("width")
+        th = options.int_option("height")
+        hint = (tw or th, th or tw) if (tw or th) else None
+
+        gif_frame = options.int_option("gif-frame", 0) or 0
+        decoded = decode(data, target_hint=hint, frame=gif_frame)
+        timings["decode"] = time.perf_counter() - t
+
+        w, h = decoded.size
+        plan = build_plan(options, w, h)
+        spec.command_repr = repr(plan)
+
+        frames = [decoded.rgb]
+        durations = None
+        if is_animated_gif_out and decoded.n_frames > 1:
+            frames, durations = _decode_all_frames(data)
+
+        t = time.perf_counter()
+        out_frames = []
+        for frame in frames:
+            fh, fw = frame.shape[:2]
+            frame_plan = plan if (fw, fh) == plan.src_size else build_plan(
+                options, fw, fh
+            )
+            if self.batcher is not None:
+                # concurrent requests sharing a program batch into one
+                # device launch; .result() parks this worker thread while
+                # the group fills (flyimg_tpu/runtime/batcher.py)
+                out_frames.append(
+                    self.batcher.submit(frame, frame_plan).result()
+                )
+            else:
+                out_frames.append(run_plan(frame, frame_plan))
+        timings["device"] = time.perf_counter() - t
+
+        # post-passes on the transformed output, in reference order:
+        # smart-crop, then face blur, then face crop — all skipped for GIF
+        # outputs (ImageHandler.php:125-152)
+        if not spec.is_gif:
+            out = out_frames[0]
+            if plan.smart_crop:
+                t = time.perf_counter()
+                out = self._smartcrop().smart_crop_image(out)
+                timings["smartcrop"] = time.perf_counter() - t
+            if plan.face_blur or plan.face_crop:
+                t = time.perf_counter()
+                faces = self._faces().detect_faces(out)
+                if plan.face_blur:
+                    out = self._faces().blur_faces(out, faces)
+                if plan.face_crop:
+                    out = self._faces().crop_face(
+                        out, faces, plan.face_crop_position
+                    )
+                timings["faces"] = time.perf_counter() - t
+            out_frames = [out]
+
+        t = time.perf_counter()
+        alpha = None
+        if decoded.alpha is not None and plan.resize_to is None and \
+                plan.extent is None and plan.extract is None and \
+                plan.rotate is None and len(out_frames) == 1 and \
+                out_frames[0].shape[:2] == decoded.alpha.shape:
+            alpha = decoded.alpha
+
+        if len(out_frames) > 1:
+            content = _encode_gif_animation(out_frames, durations)
+        else:
+            content = encode(
+                out_frames[0],
+                spec.extension,
+                quality=options.int_option("quality", 90) or 90,
+                webp_lossless=bool(options.truthy("webp-lossless")),
+                mozjpeg=str(options.get_option("mozjpeg")) == "1",
+                sampling_factor=str(options.get_option("sampling-factor") or "1x1"),
+                strip=options.truthy("strip"),
+                alpha=alpha,
+            )
+        timings["encode"] = time.perf_counter() - t
+        return content
+
+
+def _decode_all_frames(data: bytes):
+    """All frames of an animated GIF, coalesced (reference -coalesce,
+    ImageProcessor.php:74-76), plus per-frame durations."""
+    import io
+
+    from PIL import Image, ImageSequence
+
+    img = Image.open(io.BytesIO(data))
+    frames = []
+    durations = []
+    for frame in ImageSequence.Iterator(img):
+        durations.append(frame.info.get("duration", 100))
+        frames.append(np.asarray(frame.convert("RGB")).copy())
+    return frames, durations
+
+
+def _encode_gif_animation(frames, durations) -> bytes:
+    import io
+
+    from PIL import Image
+
+    pil_frames = [Image.fromarray(f) for f in frames]
+    buf = io.BytesIO()
+    pil_frames[0].save(
+        buf,
+        "GIF",
+        save_all=True,
+        append_images=pil_frames[1:],
+        duration=durations or 100,
+        loop=0,
+    )
+    return buf.getvalue()

@@ -1,0 +1,56 @@
+"""HTTP response header policy.
+
+Port of the reference Response entity (src/Core/Entity/Response.php):
+CDN-friendly long-cache headers, security headers, and the rf_1 debug/no-cache
+behavior (with `im-command` carrying the TransformPlan repr instead of a shell
+command line, and `xla-program` replacing `im-identify`'s identify output).
+"""
+
+from __future__ import annotations
+
+import email.utils
+import time
+from typing import Dict
+
+from flyimg_tpu.service.handler import ProcessedImage
+
+SECURITY_HEADERS = {
+    # reference Response.php:83-91
+    "Strict-Transport-Security": "max-age=31536000; includeSubDomains",
+    "Content-Security-Policy": "script-src 'self'",
+    "X-Frame-Options": "SAMEORIGIN",
+    "X-XSS-Protection": "1; mode=block",
+    "X-Content-Type-Options": "nosniff",
+    "Referrer-Policy": "strict-origin",
+}
+
+
+def image_headers(result: ProcessedImage, header_cache_days: int) -> Dict[str, str]:
+    """reference Response.php:43-67."""
+    headers = dict(SECURITY_HEADERS)
+    headers["Content-Type"] = result.spec.mime
+    headers["Content-Disposition"] = f'inline;filename="{result.spec.name}"'
+
+    refresh = (
+        bool(result.options.get("refresh"))
+        and str(result.options.get("refresh")) == "1"
+    )
+    if refresh:
+        headers["Cache-Control"] = "no-cache, private"
+        # debug headers (reference Response.php:58-64): the exact device
+        # program description stands in for the convert command line
+        headers["im-command"] = result.spec.command_repr[:2000]
+        if result.timings:
+            headers["x-flyimg-timings"] = ",".join(
+                f"{k}={v * 1000:.1f}ms" for k, v in result.timings.items()
+            )
+    else:
+        long_cache = 3600 * 24 * int(header_cache_days)
+        headers["Cache-Control"] = (
+            f"max-age={long_cache}, public, s-maxage={long_cache}"
+        )
+        headers["Expires"] = email.utils.formatdate(
+            time.time() + 365 * 24 * 3600, usegmt=True
+        )
+    headers["Last-Modified"] = email.utils.formatdate(time.time(), usegmt=True)
+    return headers

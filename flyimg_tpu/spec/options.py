@@ -212,10 +212,11 @@ class OptionsBag:
         try:
             return int(value)
         except (TypeError, ValueError):
-            # IM parses geometry numbers with strtod: leading numeric prefix,
-            # trailing garbage ignored — 'w_200.5' resizes to ~200, 'w_200px'
-            # to 200. Match that rather than dropping the op.
-            match = re.match(r"\s*[-+]?\d*\.?\d+", str(value))
+            # IM parses geometry numbers with strtod: leading numeric prefix
+            # (incl. exponents), trailing garbage ignored — 'w_200.5' resizes
+            # to ~200, 'w_200px' to 200, 'w_2e3' to 2000. (Hex floats, which
+            # strtod also accepts, are not supported.)
+            match = re.match(r"\s*[-+]?\d*\.?\d+(?:[eE][-+]?\d+)?", str(value))
             if not match:
                 return default
             try:

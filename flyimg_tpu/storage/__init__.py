@@ -1,0 +1,20 @@
+"""Storage backends for processed outputs.
+
+The Flysystem equivalent (reference src/Core/StorageProvider/): a tiny
+has/read/write/delete contract plus a public-URL formatter. Local disk and
+S3 (gated on boto3) are provided, matching the reference's two providers.
+"""
+
+from flyimg_tpu.storage.base import Storage  # noqa: F401
+from flyimg_tpu.storage.local import LocalStorage  # noqa: F401
+
+
+def make_storage(params) -> "Storage":
+    """Select the backend by the ``storage_system`` server param
+    (reference app.php:54-62)."""
+    system = params.by_key("storage_system", "local")
+    if system == "s3":
+        from flyimg_tpu.storage.s3 import S3Storage
+
+        return S3Storage(params)
+    return LocalStorage(params)

@@ -1,0 +1,25 @@
+"""Storage contract (Flysystem-equivalent surface the handler consumes:
+has/read/write/delete + public URL; reference LocalStorageProvider.php:26-48)."""
+
+from __future__ import annotations
+
+import abc
+from typing import Optional
+
+
+class Storage(abc.ABC):
+    @abc.abstractmethod
+    def has(self, name: str) -> bool: ...
+
+    @abc.abstractmethod
+    def read(self, name: str) -> bytes: ...
+
+    @abc.abstractmethod
+    def write(self, name: str, data: bytes) -> None: ...
+
+    @abc.abstractmethod
+    def delete(self, name: str) -> None: ...
+
+    @abc.abstractmethod
+    def public_url(self, name: str, request_base: Optional[str] = None) -> str:
+        """Public URL for the /path route (reference Response.php:108-113)."""

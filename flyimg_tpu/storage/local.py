@@ -1,0 +1,53 @@
+"""Local-disk storage (reference LocalStorageProvider.php).
+
+Public URL resolution mirrors the reference: HOSTNAME_URL env wins, else the
+request's scheme://host, with the '/uploads/%s' web path
+(LocalStorageProvider.php:38-48, constants.php UPLOAD_WEB_DIR)."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from flyimg_tpu.storage.base import Storage
+
+UPLOAD_WEB_DIR = "uploads/"
+
+
+class LocalStorage(Storage):
+    def __init__(self, params) -> None:
+        self.root = os.path.abspath(params.by_key("upload_dir", "web/uploads"))
+        os.makedirs(self.root, exist_ok=True)
+
+    def _path(self, name: str) -> str:
+        # content-addressed names are md5 hex + extension; never trust them
+        # as paths
+        safe = os.path.basename(name)
+        return os.path.join(self.root, safe)
+
+    def has(self, name: str) -> bool:
+        return os.path.exists(self._path(name))
+
+    def read(self, name: str) -> bytes:
+        with open(self._path(name), "rb") as fh:
+            return fh.read()
+
+    def write(self, name: str, data: bytes) -> None:
+        path = self._path(name)
+        tmp = path + ".part"
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+        # atomic publish: concurrent same-key writers race benignly
+        # (last-write-wins, like the reference's Flysystem write;
+        # SURVEY.md section 5 'race detection')
+        os.replace(tmp, path)
+
+    def delete(self, name: str) -> None:
+        try:
+            os.remove(self._path(name))
+        except FileNotFoundError:
+            pass
+
+    def public_url(self, name: str, request_base: Optional[str] = None) -> str:
+        base = os.environ.get("HOSTNAME_URL") or request_base or ""
+        return f"{base.rstrip('/')}/{UPLOAD_WEB_DIR}{name}"

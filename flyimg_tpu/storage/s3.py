@@ -1,0 +1,58 @@
+"""S3 storage (reference S3StorageProvider.php), gated on boto3.
+
+Validates credentials up front like the reference (S3StorageProvider.php:
+27-29) and exposes the same public-URL pattern
+``https://s3.{region}.amazonaws.com/{bucket}/{name}`` (:33)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from flyimg_tpu.exceptions import MissingParamsException
+from flyimg_tpu.storage.base import Storage
+
+
+class S3Storage(Storage):
+    def __init__(self, params) -> None:
+        conf = params.by_key("aws_s3", {}) or {}
+        self.access_id = conf.get("access_id", "")
+        self.secret_key = conf.get("secret_key", "")
+        self.region = conf.get("region", "")
+        self.bucket = conf.get("bucket_name", "")
+        if not all([self.access_id, self.secret_key, self.region, self.bucket]):
+            raise MissingParamsException(
+                "s3 storage selected but aws_s3 access_id/secret_key/region/"
+                "bucket_name are not all set"
+            )
+        try:
+            import boto3
+        except ImportError as exc:
+            raise MissingParamsException(
+                "s3 storage selected but boto3 is not installed"
+            ) from exc
+        self._client = boto3.client(
+            "s3",
+            aws_access_key_id=self.access_id,
+            aws_secret_access_key=self.secret_key,
+            region_name=self.region,
+        )
+
+    def has(self, name: str) -> bool:
+        try:
+            self._client.head_object(Bucket=self.bucket, Key=name)
+            return True
+        except Exception:
+            return False
+
+    def read(self, name: str) -> bytes:
+        obj = self._client.get_object(Bucket=self.bucket, Key=name)
+        return obj["Body"].read()
+
+    def write(self, name: str, data: bytes) -> None:
+        self._client.put_object(Bucket=self.bucket, Key=name, Body=data)
+
+    def delete(self, name: str) -> None:
+        self._client.delete_object(Bucket=self.bucket, Key=name)
+
+    def public_url(self, name: str, request_base: Optional[str] = None) -> str:
+        return f"https://s3.{self.region}.amazonaws.com/{self.bucket}/{name}"

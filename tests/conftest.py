@@ -1,12 +1,27 @@
 """Test harness config: force the CPU backend with a virtual 8-device mesh
 so sharding tests run anywhere (the standard fake-mesh trick; see SURVEY.md
-section 4). Must run before jax initializes a backend."""
+section 4).
+
+Note: this environment's sitecustomize force-selects the axon/TPU platform
+via jax.config at interpreter start, overriding the JAX_PLATFORMS env var —
+so the override here must go through jax.config.update AFTER importing jax,
+before any backend initializes.
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import re
+
 flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)
+os.environ["XLA_FLAGS"] = (
+    flags + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+assert jax.devices()[0].platform == "cpu", jax.devices()
+assert len(jax.devices()) == 8, jax.devices()

@@ -1,0 +1,81 @@
+"""BatchController tests: grouping, padding, correctness vs the single-image
+path, deadline flush, mixed-aspect fit batching."""
+
+import numpy as np
+import pytest
+
+from flyimg_tpu.ops.compose import run_plan
+from flyimg_tpu.runtime.batcher import BatchController
+from flyimg_tpu.spec.options import OptionsBag
+from flyimg_tpu.spec.plan import build_plan
+
+from test_ops import make_test_image
+
+
+@pytest.fixture()
+def controller():
+    ctl = BatchController(max_batch=8, deadline_ms=30.0)
+    yield ctl
+    ctl.close()
+
+
+def _plan(opts, w, h):
+    return build_plan(OptionsBag(opts), w, h)
+
+
+def test_batch_matches_single_path(controller):
+    futures = []
+    sources = []
+    for i, (w, h) in enumerate([(600, 400), (620, 410), (580, 390), (600, 400)]):
+        img = make_test_image(w, h, seed=i)
+        plan = _plan("w_200,h_150,c_1", w, h)
+        sources.append((img, plan))
+        futures.append(controller.submit(img, plan))
+    outs = [f.result(timeout=120) for f in futures]
+    for out, (img, plan) in zip(outs, sources):
+        assert out.shape == (150, 200, 3)
+        single = run_plan(img, plan)
+        # batch path must be pixel-identical to the single path
+        np.testing.assert_array_equal(out, single)
+
+
+def test_mixed_aspect_fit_shares_batch(controller):
+    futures = []
+    expected_shapes = []
+    # different aspects, same 128-px input bucket (640 x 512)
+    for i, (w, h) in enumerate([(600, 400), (600, 430), (600, 450)]):
+        img = make_test_image(w, h, seed=10 + i)
+        plan = _plan("w_300", w, h)
+        expected_shapes.append((plan.resize_to[1], plan.resize_to[0], 3))
+        futures.append(controller.submit(img, plan))
+    outs = [f.result(timeout=120) for f in futures]
+    for out, shape in zip(outs, expected_shapes):
+        assert out.shape == shape
+    stats = controller.stats()
+    # all three different aspects must have run as ONE batch
+    assert stats["batches"] == 1
+    assert stats["images"] == 3
+
+
+def test_deadline_flush_single_item(controller):
+    img = make_test_image(300, 200)
+    fut = controller.submit(img, _plan("w_100", 300, 200))
+    out = fut.result(timeout=120)
+    assert out.shape == (67, 100, 3)
+
+
+def test_mismatched_plan_rejected(controller):
+    img = make_test_image(300, 200)
+    with pytest.raises(ValueError):
+        controller.submit(img, _plan("w_100", 999, 999))
+
+
+def test_different_ops_in_different_groups(controller):
+    img_a = make_test_image(300, 200, seed=1)
+    img_b = make_test_image(300, 200, seed=2)
+    fa = controller.submit(img_a, _plan("w_100,clsp_gray", 300, 200))
+    fb = controller.submit(img_b, _plan("w_100", 300, 200))
+    out_a = fa.result(timeout=120)
+    out_b = fb.result(timeout=120)
+    np.testing.assert_array_equal(out_a[..., 0], out_a[..., 1])
+    assert not np.array_equal(out_b[..., 0], out_b[..., 1])

