@@ -92,13 +92,18 @@ class BatchController:
         *,
         max_batch: int = 64,
         deadline_ms: float = 4.0,
+        metrics=None,
     ) -> None:
+        from flyimg_tpu.runtime.metrics import MetricsRegistry
+
         self.max_batch = max_batch
         self.deadline_s = deadline_ms / 1000.0
+        # single source of truth for batch accounting; the app passes its
+        # shared registry, standalone use gets a private one
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
         self._groups: Dict[Tuple, _Group] = {}
         self._lock = threading.Condition()
         self._stop = False
-        self._stats = {"batches": 0, "images": 0, "occupancy_sum": 0.0}
         self._thread = threading.Thread(
             target=self._run, name="flyimg-batcher", daemon=True
         )
@@ -177,11 +182,14 @@ class BatchController:
         return future
 
     def stats(self) -> Dict[str, float]:
-        with self._lock:
-            stats = dict(self._stats)
-        batches = max(stats["batches"], 1)
-        stats["mean_occupancy"] = stats["occupancy_sum"] / batches
-        return stats
+        summary = self.metrics.summary()
+        images = summary.get("flyimg_images_processed_total", 0.0)
+        slots = summary.get("flyimg_batch_slots_total", 0.0)
+        return {
+            "batches": summary.get("flyimg_batches_total", 0.0),
+            "images": images,
+            "mean_occupancy": images / slots if slots else 0.0,
+        }
 
     def close(self) -> None:
         with self._lock:
@@ -316,10 +324,7 @@ class BatchController:
                     jnp.asarray(out_true),
                 )
             )
-            with self._lock:
-                self._stats["batches"] += 1
-                self._stats["images"] += n
-                self._stats["occupancy_sum"] += n / batch
+            self.metrics.record_batch(n, batch)
             for i, member in enumerate(members):
                 result = out[i]
                 if member.needs_slice:

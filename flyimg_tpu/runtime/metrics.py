@@ -1,0 +1,247 @@
+"""Service metrics: counters + latency histograms + Prometheus rendering.
+
+The reference has no metrics at all (SURVEY.md section 5 "Metrics /
+logging": exceptions to stdout and nginx access logs are the whole story).
+A batched TPU serving tier is not operable blind, so this subsystem provides
+the counters the baseline targets are phrased in — images/sec, batch
+occupancy, per-stage latency p50/p99 — exposed in Prometheus text format by
+the `/metrics` route (flyimg_tpu/service/app.py).
+
+Design notes:
+- Histograms use fixed log-spaced buckets (120 us .. ~2 min) so quantile
+  estimates need no per-sample storage and merging across threads is just
+  integer adds — the standard Prometheus histogram design.
+- Everything is guarded by one lock per registry; recording is a few dict
+  ops, far off any hot path (the hot path is the device, ~ms per batch).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# log-spaced latency buckets in seconds: 23 buckets, x1.8 apart,
+# 120us .. ~113s — covers device-batch latencies through cold compiles.
+_BUCKET_BASE = 0.00012
+_BUCKET_FACTOR = 1.8
+_N_BUCKETS = 23
+BUCKET_BOUNDS: Tuple[float, ...] = tuple(
+    _BUCKET_BASE * _BUCKET_FACTOR ** i for i in range(_N_BUCKETS)
+)
+
+
+class Counter:
+    """Monotonic counter with optional labels baked into the name."""
+
+    def __init__(self, name: str, help_text: str = "") -> None:
+        self.name = name
+        self.help = help_text
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Fixed-bucket latency histogram with quantile estimation."""
+
+    def __init__(self, name: str, help_text: str = "") -> None:
+        self.name = name
+        self.help = help_text
+        self._counts = [0] * (_N_BUCKETS + 1)  # +1 overflow bucket
+        self._sum = 0.0
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def observe(self, seconds: float) -> None:
+        idx = _N_BUCKETS
+        for i, bound in enumerate(BUCKET_BOUNDS):
+            if seconds <= bound:
+                idx = i
+                break
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += seconds
+            self._n += 1
+
+    def quantile(self, q: float) -> float:
+        """Upper-bound estimate of the q-quantile (0 < q <= 1)."""
+        with self._lock:
+            n = self._n
+            counts = list(self._counts)
+        if n == 0:
+            return 0.0
+        target = math.ceil(q * n)
+        acc = 0
+        for i, c in enumerate(counts):
+            acc += c
+            if acc >= target:
+                return BUCKET_BOUNDS[i] if i < _N_BUCKETS else float("inf")
+        return float("inf")
+
+    def snapshot(self) -> Tuple[List[int], float, int]:
+        with self._lock:
+            return list(self._counts), self._sum, self._n
+
+
+class MetricsRegistry:
+    """Named metric store; one per app."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._histograms: Dict[str, Histogram] = {}
+        self.started_at = time.time()
+
+    def counter(self, name: str, help_text: str = "") -> Counter:
+        with self._lock:
+            metric = self._counters.get(name)
+            if metric is None:
+                metric = Counter(name, help_text)
+                self._counters[name] = metric
+            return metric
+
+    def histogram(self, name: str, help_text: str = "") -> Histogram:
+        with self._lock:
+            metric = self._histograms.get(name)
+            if metric is None:
+                metric = Histogram(name, help_text)
+                self._histograms[name] = metric
+            return metric
+
+    # -- recording helpers used by the serving path ------------------------
+
+    def record_request(self, route: str, status: int) -> None:
+        self.counter(
+            f'flyimg_requests_total{{route="{route}",status="{status}"}}',
+            "HTTP requests by route and status",
+        ).inc()
+
+    def record_stage(self, stage: str, seconds: float) -> None:
+        self.histogram(
+            f'flyimg_stage_seconds{{stage="{stage}"}}',
+            "Per-stage pipeline latency",
+        ).observe(seconds)
+
+    def record_cache(self, hit: bool) -> None:
+        self.counter(
+            f'flyimg_cache_total{{result="{"hit" if hit else "miss"}"}}',
+            "Output-cache lookups",
+        ).inc()
+
+    def record_batch(self, images: int, capacity: int) -> None:
+        self.counter(
+            "flyimg_batches_total", "Device batches executed"
+        ).inc()
+        self.counter(
+            "flyimg_images_processed_total", "Images through the device"
+        ).inc(images)
+        self.counter(
+            "flyimg_batch_slots_total", "Padded batch slots (occupancy denom)"
+        ).inc(capacity)
+
+    # -- rendering ---------------------------------------------------------
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition. Metric objects are stored per
+        label-set, so rendering groups them into families (one HELP/TYPE
+        block per bare metric name, all samples contiguous) as the
+        exposition format requires."""
+        lines: List[str] = []
+        with self._lock:
+            counters = list(self._counters.values())
+            histograms = list(self._histograms.values())
+
+        for family in _families(counters):
+            head = family[0]
+            if head.help:
+                lines.append(f"# HELP {_bare(head.name)} {head.help}")
+                lines.append(f"# TYPE {_bare(head.name)} counter")
+            for c in family:
+                lines.append(f"{c.name} {_fmt(c.value)}")
+
+        for family in _families(histograms):
+            head = family[0]
+            bare = _bare(head.name)
+            if head.help:
+                lines.append(f"# HELP {bare} {head.help}")
+                lines.append(f"# TYPE {bare} histogram")
+            for h in family:
+                counts, total, n = h.snapshot()
+                acc = 0
+                for i, count in enumerate(counts):
+                    acc += count
+                    le = (
+                        f"{BUCKET_BOUNDS[i]:.6f}" if i < _N_BUCKETS else "+Inf"
+                    )
+                    lines.append(
+                        f'{_with_label(h.name, "le", le, suffix="_bucket")} '
+                        f"{acc}"
+                    )
+                lines.append(f"{_suffixed(h.name, '_sum')} {_fmt(total)}")
+                lines.append(f"{_suffixed(h.name, '_count')} {n}")
+        lines.append(
+            f"flyimg_uptime_seconds {_fmt(time.time() - self.started_at)}"
+        )
+        return "\n".join(lines) + "\n"
+
+    def summary(self) -> Dict[str, float]:
+        """Human/JSON view: key counters + p50/p99 per stage."""
+        out: Dict[str, float] = {}
+        with self._lock:
+            counters = dict(self._counters)
+            histograms = dict(self._histograms)
+        for name, c in counters.items():
+            out[name] = c.value
+        for name, h in histograms.items():
+            out[f"{name}:p50"] = h.quantile(0.5)
+            out[f"{name}:p99"] = h.quantile(0.99)
+        slots = out.get("flyimg_batch_slots_total", 0.0)
+        if slots:
+            out["flyimg_batch_occupancy"] = (
+                out.get("flyimg_images_processed_total", 0.0) / slots
+            )
+        return out
+
+
+def _families(metrics: Iterable) -> List[List]:
+    """Group metric objects by bare family name, preserving first-seen
+    order of families and of members within a family."""
+    grouped: Dict[str, List] = {}
+    for metric in metrics:
+        grouped.setdefault(_bare(metric.name), []).append(metric)
+    return list(grouped.values())
+
+
+def _bare(name: str) -> str:
+    return name.split("{", 1)[0]
+
+
+def _suffixed(name: str, suffix: str) -> str:
+    if "{" in name:
+        head, rest = name.split("{", 1)
+        return f"{head}{suffix}{{{rest}"
+    return name + suffix
+
+
+def _with_label(name: str, key: str, value: str, suffix: str = "") -> str:
+    if "{" in name:
+        head, rest = name.split("{", 1)
+        rest = rest.rstrip("}")
+        return f'{head}{suffix}{{{rest},{key}="{value}"}}'
+    return f'{name}{suffix}{{{key}="{value}"}}'
+
+
+def _fmt(value: float) -> str:
+    if value == int(value) and abs(value) < 1e15:
+        return str(int(value))
+    return repr(value)

@@ -23,6 +23,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import sys
+import time
 from typing import Optional
 
 from aiohttp import web
@@ -66,16 +67,20 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
     params = params or AppParameters()
     storage = make_storage(params)
     from flyimg_tpu.runtime import BatchController
+    from flyimg_tpu.runtime.metrics import MetricsRegistry
 
+    metrics = MetricsRegistry()
     batcher = BatchController(
         max_batch=int(params.by_key("batch_max_size", 64)),
         deadline_ms=float(params.by_key("batch_deadline_ms", 4.0)),
+        metrics=metrics,
     )
-    handler = ImageHandler(storage, params, batcher=batcher)
+    handler = ImageHandler(storage, params, batcher=batcher, metrics=metrics)
 
     app = web.Application(client_max_size=64 * 1024 * 1024)
     app["params"] = params
     app["handler"] = handler
+    app["metrics"] = metrics
 
     async def _close_batcher(_app):
         batcher.close()
@@ -103,22 +108,97 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
         try:
             result = await _process(request)
         except AppException as exc:
-            return _error_response(exc)
+            resp = _error_response(exc)
+            metrics.record_request("upload", resp.status)
+            return resp
         headers = image_headers(
             result, params.by_key("header_cache_days", 365)
         )
+        metrics.record_request("upload", 200)
         return web.Response(body=result.content, headers=headers)
 
     async def path(request: web.Request) -> web.Response:
         try:
             result = await _process(request)
         except AppException as exc:
-            return _error_response(exc)
+            resp = _error_response(exc)
+            metrics.record_request("path", resp.status)
+            return resp
         base = f"{request.scheme}://{request.host}"
         url = storage.public_url(result.spec.name, base)
+        metrics.record_request("path", 200)
         return web.Response(text=url)
 
+    async def metrics_route(_request: web.Request) -> web.Response:
+        return web.Response(
+            text=metrics.render_prometheus(),
+            content_type="text/plain",
+            charset="utf-8",
+        )
+
+    async def healthz(_request: web.Request) -> web.Response:
+        """Liveness + device visibility (the reference's analog is 'is
+        nginx/php-fpm up'; here the chip is part of the health surface)."""
+        import json as _json
+
+        try:
+            import jax
+
+            devices = [f"{d.platform}:{d.id}" for d in jax.devices()]
+            body = {"status": "ok", "devices": devices}
+            status = 200
+        except Exception as exc:  # device runtime down
+            body = {"status": "error", "error": str(exc)}
+            status = 503
+        return web.Response(
+            text=_json.dumps(body), status=status,
+            content_type="application/json",
+        )
+
+    trace_lock = asyncio.Lock()
+
+    async def debug_trace(request: web.Request) -> web.Response:
+        """Capture a jax.profiler device trace for ?ms= milliseconds (default
+        500, max 30s) into tmp_dir/traces; returns the trace directory. The
+        TPU replacement for the reference's rf_1 'im-command' debugging
+        (SURVEY.md section 5 tracing). Only served when the `debug` server
+        parameter is on — profiling is an operator tool, not a public route."""
+        import json as _json
+        import os as _os
+
+        if not params.by_key("debug"):
+            return web.Response(
+                status=403, text="debug disabled (set debug: true in params)"
+            )
+        try:
+            ms = min(float(request.query.get("ms", 500)), 30_000.0)
+            if not ms > 0:
+                raise ValueError
+        except ValueError:
+            return web.Response(status=400, text="ms must be a positive number")
+        if trace_lock.locked():
+            return web.Response(status=409, text="a trace is already running")
+        trace_dir = _os.path.join(
+            str(params.by_key("tmp_dir", "var/tmp")), "traces",
+            time.strftime("%Y%m%d-%H%M%S"),
+        )
+        import jax
+
+        async with trace_lock:
+            jax.profiler.start_trace(trace_dir)
+            try:
+                await asyncio.sleep(ms / 1000.0)
+            finally:
+                jax.profiler.stop_trace()
+        return web.Response(
+            text=_json.dumps({"trace_dir": trace_dir, "captured_ms": ms}),
+            content_type="application/json",
+        )
+
     app.router.add_get("/", index)
+    app.router.add_get("/metrics", metrics_route)
+    app.router.add_get("/healthz", healthz)
+    app.router.add_get("/debug/trace", debug_trace)
     # imageSrc uses a catch-all pattern so full URLs (with slashes) work as
     # path parameters — the reference's `imageSrc: .+` route requirement
     # (config/routes.yml:9,14)

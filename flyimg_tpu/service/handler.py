@@ -53,11 +53,13 @@ class ImageHandler:
         batcher=None,
         face_backend=None,
         smartcrop_backend=None,
+        metrics=None,
     ) -> None:
         self.storage = storage
         self.params = params
         self.security = SecurityHandler(params)
         self.batcher = batcher  # BatchController; None = direct device calls
+        self.metrics = metrics  # runtime.metrics.MetricsRegistry or None
         self._face_backend = face_backend
         self._smartcrop_backend = smartcrop_backend
 
@@ -117,6 +119,9 @@ class ImageHandler:
             self.storage.delete(spec.name)
 
         if self.storage.has(spec.name):
+            if self.metrics is not None:
+                self.metrics.record_cache(hit=True)
+                self.metrics.record_stage("cache_hit", time.perf_counter() - t0)
             return ProcessedImage(
                 content=self.storage.read(spec.name),
                 spec=spec,
@@ -128,6 +133,10 @@ class ImageHandler:
         content = self._process_new(source.data, options, spec, timings)
         self.storage.write(spec.name, content)
         timings["total"] = time.perf_counter() - t0
+        if self.metrics is not None:
+            self.metrics.record_cache(hit=False)
+            for stage, seconds in timings.items():
+                self.metrics.record_stage(stage, seconds)
         return ProcessedImage(
             content=content, spec=spec, options=options, timings=timings
         )
