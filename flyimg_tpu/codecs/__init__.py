@@ -21,6 +21,19 @@ from flyimg_tpu.codecs.exif import apply_orientation, jpeg_orientation
 from flyimg_tpu.codecs.pil_codec import DecodedImage
 
 
+def media_info(data: bytes) -> MediaInfo:
+    """Identify media type + dims from leading bytes. Prefers the native
+    C probe (fc_probe, the in-process `identify` replacement); the pure-
+    Python sniffer is the fallback when the library isn't built."""
+    head = data[:65536]
+    if native_codec.available():
+        probed = native_codec.probe(head)
+        if probed is not None:
+            mime, width, height, _depth = probed
+            return MediaInfo(mime, width or None, height or None)
+    return sniff(head)
+
+
 def _dct_scale_num(src_w: int, src_h: int, hint: Tuple[int, int]) -> int:
     """Smallest libjpeg DCT scale (scale_num/8) that keeps the decoded image
     >= 2x the target box on both axes, so the device resample remains the
@@ -42,7 +55,7 @@ def decode(
 ) -> DecodedImage:
     """Decode bytes -> DecodedImage. JPEG/WebP ride the native codec when
     built; everything else (and all alpha/animation handling) uses PIL."""
-    info = sniff(data[:65536])
+    info = media_info(data)
     if native_codec.available():
         if info.mime == "image/jpeg":
             scale_num = 8
@@ -67,6 +80,18 @@ def decode(
                     mime="image/webp",
                     orig_size=(rgb.shape[1], rgb.shape[0]),
                 )
+        elif info.mime == "image/png":
+            decoded = native_codec.png_decode(data)
+            if decoded is not None:
+                pixels, channels = decoded
+                alpha = pixels[..., 3] if channels == 4 else None
+                rgb = np.ascontiguousarray(pixels[..., :3])
+                return DecodedImage(
+                    rgb=rgb,
+                    alpha=alpha,
+                    mime="image/png",
+                    orig_size=(rgb.shape[1], rgb.shape[0]),
+                )
     return pil_codec.decode(data, target_hint=target_hint, frame=frame)
 
 
@@ -82,7 +107,14 @@ def encode(
     alpha: Optional[np.ndarray] = None,
 ) -> bytes:
     """Encode via the native codec where it covers the case (jpg, webp
-    without alpha); PIL otherwise."""
+    without alpha; png with or without); PIL otherwise."""
+    if native_codec.available() and fmt == "png":
+        pixels = image
+        if alpha is not None:
+            pixels = np.dstack([image, alpha])
+        blob = native_codec.png_encode(pixels)
+        if blob is not None:
+            return blob
     if native_codec.available() and alpha is None:
         if fmt in ("jpg", "jpeg"):
             blob = native_codec.jpeg_encode(

@@ -24,6 +24,7 @@
 #include <cstring>
 
 #include <jpeglib.h>
+#include <png.h>
 #include <webp/decode.h>
 #include <webp/encode.h>
 
@@ -158,6 +159,192 @@ uint8_t* fc_jpeg_encode(const uint8_t* rgb, int width, int height, int quality,
   if (out) std::memcpy(out, mem, mem_len);
   std::free(mem);
   return out;
+}
+
+// ---------------------------------------------------------------------------
+// PNG (libpng 1.6 simplified API)
+// ---------------------------------------------------------------------------
+
+// Decode PNG to 8-bit RGB or RGBA. channels: pass 3 or 4 to force, or 0 to
+// auto-detect (4 iff the file has alpha). Returns malloc'd buffer.
+uint8_t* fc_png_decode(const uint8_t* data, size_t len, int want_channels,
+                       int* width, int* height, int* channels) {
+  png_image image;
+  std::memset(&image, 0, sizeof(image));
+  image.version = PNG_IMAGE_VERSION;
+  if (!png_image_begin_read_from_memory(&image, data, len)) return nullptr;
+  int ch = want_channels;
+  if (ch == 0) {
+    ch = (image.format & PNG_FORMAT_FLAG_ALPHA) ? 4 : 3;
+  }
+  image.format = (ch == 4) ? PNG_FORMAT_RGBA : PNG_FORMAT_RGB;
+  const size_t stride = static_cast<size_t>(image.width) * ch;
+  uint8_t* out = static_cast<uint8_t*>(std::malloc(stride * image.height));
+  if (!out) {
+    png_image_free(&image);
+    return nullptr;
+  }
+  if (!png_image_finish_read(&image, nullptr, out, static_cast<png_int_32>(stride),
+                             nullptr)) {
+    std::free(out);
+    png_image_free(&image);
+    return nullptr;
+  }
+  *width = static_cast<int>(image.width);
+  *height = static_cast<int>(image.height);
+  *channels = ch;
+  return out;
+}
+
+// Encode 8-bit RGB/RGBA to PNG. Returns malloc'd buffer.
+uint8_t* fc_png_encode(const uint8_t* pixels, int width, int height,
+                       int channels, size_t* out_len) {
+  png_image image;
+  std::memset(&image, 0, sizeof(image));
+  image.version = PNG_IMAGE_VERSION;
+  image.width = static_cast<png_uint_32>(width);
+  image.height = static_cast<png_uint_32>(height);
+  image.format = (channels == 4) ? PNG_FORMAT_RGBA : PNG_FORMAT_RGB;
+  const png_int_32 stride = width * channels;
+  // first pass: measure
+  png_alloc_size_t size = 0;
+  if (!png_image_write_to_memory(&image, nullptr, &size, 0, pixels, stride,
+                                 nullptr)) {
+    return nullptr;
+  }
+  uint8_t* out = static_cast<uint8_t*>(std::malloc(size));
+  if (!out) return nullptr;
+  if (!png_image_write_to_memory(&image, out, &size, 0, pixels, stride,
+                                 nullptr)) {
+    std::free(out);
+    return nullptr;
+  }
+  *out_len = size;
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// header probe: format + dimensions + bit depth without a full decode —
+// the native `identify` equivalent (reference runs
+// `/usr/bin/identify` per image, src/Core/Entity/ImageMetaInfo.php:143-166).
+// ---------------------------------------------------------------------------
+
+enum fc_format {
+  FC_UNKNOWN = 0,
+  FC_JPEG = 1,
+  FC_PNG = 2,
+  FC_GIF = 3,
+  FC_WEBP = 4,
+  FC_BMP = 5,
+  FC_PDF = 6,
+  FC_MP4 = 7,
+  FC_WEBM = 8,
+  FC_AVI = 9,
+  FC_MOV = 10,
+};
+
+static uint16_t be16(const uint8_t* p) { return (p[0] << 8) | p[1]; }
+static uint32_t be32(const uint8_t* p) {
+  return (static_cast<uint32_t>(p[0]) << 24) | (p[1] << 16) | (p[2] << 8) | p[3];
+}
+static uint16_t le16(const uint8_t* p) { return p[0] | (p[1] << 8); }
+static uint32_t le24(const uint8_t* p) { return p[0] | (p[1] << 8) | (p[2] << 16); }
+static uint32_t le32(const uint8_t* p) {
+  return p[0] | (p[1] << 8) | (p[2] << 16) | (static_cast<uint32_t>(p[3]) << 24);
+}
+
+// Walk JPEG markers to the SOFn frame header for dims + sample precision.
+static void probe_jpeg(const uint8_t* d, size_t n, int* w, int* h, int* depth) {
+  size_t i = 2;
+  while (i + 9 < n) {
+    if (d[i] != 0xFF) {
+      ++i;
+      continue;
+    }
+    const uint8_t marker = d[i + 1];
+    if (marker == 0xFF) {  // legal fill byte before a marker
+      ++i;
+      continue;
+    }
+    if (marker == 0xD8 || marker == 0x01 || (marker >= 0xD0 && marker <= 0xD7)) {
+      i += 2;
+      continue;
+    }
+    if (i + 4 > n) return;
+    const uint16_t seglen = be16(d + i + 2);
+    if (marker >= 0xC0 && marker <= 0xCF && marker != 0xC4 && marker != 0xC8 &&
+        marker != 0xCC) {
+      if (i + 9 <= n) {
+        *depth = d[i + 4];
+        *h = be16(d + i + 5);
+        *w = be16(d + i + 7);
+      }
+      return;
+    }
+    i += 2 + seglen;
+  }
+}
+
+// Identify format/dims/bit-depth from leading bytes (>= 64 recommended).
+// Returns an fc_format code; unknown fields stay 0.
+int fc_probe(const uint8_t* d, size_t n, int* width, int* height, int* depth) {
+  *width = *height = *depth = 0;
+  if (n < 12) return FC_UNKNOWN;
+  if (d[0] == 0xFF && d[1] == 0xD8 && d[2] == 0xFF) {
+    probe_jpeg(d, n, width, height, depth);
+    return FC_JPEG;
+  }
+  if (std::memcmp(d, "\x89PNG\r\n\x1a\n", 8) == 0) {
+    if (n >= 25) {
+      *width = static_cast<int>(be32(d + 16));
+      *height = static_cast<int>(be32(d + 20));
+      *depth = d[24];  // IHDR bit depth
+    }
+    return FC_PNG;
+  }
+  if (std::memcmp(d, "GIF87a", 6) == 0 || std::memcmp(d, "GIF89a", 6) == 0) {
+    *width = le16(d + 6);
+    *height = le16(d + 8);
+    if (n >= 11) *depth = ((d[10] >> 4) & 0x7) + 1;  // color resolution bits
+    return FC_GIF;
+  }
+  if (std::memcmp(d, "RIFF", 4) == 0 && n >= 16 &&
+      std::memcmp(d + 8, "WEBP", 4) == 0) {
+    *depth = 8;
+    if (n >= 30) {
+      if (std::memcmp(d + 12, "VP8 ", 4) == 0) {
+        *width = le16(d + 26) & 0x3FFF;
+        *height = le16(d + 28) & 0x3FFF;
+      } else if (std::memcmp(d + 12, "VP8L", 4) == 0) {
+        const uint32_t bits = le32(d + 21);
+        *width = static_cast<int>((bits & 0x3FFF) + 1);
+        *height = static_cast<int>(((bits >> 14) & 0x3FFF) + 1);
+      } else if (std::memcmp(d + 12, "VP8X", 4) == 0) {
+        *width = static_cast<int>(le24(d + 24) + 1);
+        *height = static_cast<int>(le24(d + 27) + 1);
+      }
+    }
+    return FC_WEBP;
+  }
+  if (d[0] == 'B' && d[1] == 'M') {
+    if (n >= 30) {
+      *width = static_cast<int>(le32(d + 18));
+      const int32_t raw_h = static_cast<int32_t>(le32(d + 22));
+      *height = raw_h < 0 ? -raw_h : raw_h;
+      *depth = le16(d + 28);
+    }
+    return FC_BMP;
+  }
+  if (std::memcmp(d, "%PDF-", 5) == 0) return FC_PDF;
+  if (n >= 12 && std::memcmp(d + 4, "ftyp", 4) == 0) {
+    if (std::memcmp(d + 8, "qt  ", 4) == 0) return FC_MOV;
+    return FC_MP4;
+  }
+  if (std::memcmp(d, "\x1a\x45\xdf\xa3", 4) == 0) return FC_WEBM;
+  if (std::memcmp(d, "RIFF", 4) == 0 && std::memcmp(d + 8, "AVI ", 4) == 0) {
+    return FC_AVI;
+  }
+  return FC_UNKNOWN;
 }
 
 // ---------------------------------------------------------------------------

@@ -70,6 +70,23 @@ def _load():
             ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.POINTER(ctypes.c_size_t),
         ]
+        lib.fc_png_decode.restype = ctypes.c_void_p
+        lib.fc_png_decode.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.fc_png_encode.restype = ctypes.c_void_p
+        lib.fc_png_encode.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
+        lib.fc_probe.restype = ctypes.c_int
+        lib.fc_probe.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int),
+        ]
         lib.fc_webp_decode.restype = ctypes.c_void_p
         lib.fc_webp_decode.argtypes = [
             ctypes.c_char_p, ctypes.c_size_t,
@@ -135,6 +152,80 @@ def jpeg_encode(
     ptr = lib.fc_jpeg_encode(
         rgb.tobytes(), w, h, int(quality), int(optimize), int(progressive),
         0 if subsampling_444 else 2, ctypes.byref(out_len),
+    )
+    if not ptr:
+        return None
+    arr = _take_buffer(lib, ptr, out_len.value)
+    return arr.tobytes()
+
+
+# fc_probe format codes (keep in sync with enum fc_format in fastcodec.cpp)
+PROBE_FORMATS = {
+    0: "application/octet-stream",
+    1: "image/jpeg",
+    2: "image/png",
+    3: "image/gif",
+    4: "image/webp",
+    5: "image/bmp",
+    6: "application/pdf",
+    7: "video/mp4",
+    8: "video/webm",
+    9: "video/x-msvideo",
+    10: "video/quicktime",
+}
+
+
+def probe(data: bytes) -> Optional[Tuple[str, int, int, int]]:
+    """Native header probe -> (mime, width, height, bit_depth); zeros where
+    the header does not carry the field. None when the lib is unavailable."""
+    lib = _load()
+    if not lib:
+        return None
+    w = ctypes.c_int()
+    h = ctypes.c_int()
+    depth = ctypes.c_int()
+    code = lib.fc_probe(
+        data, len(data), ctypes.byref(w), ctypes.byref(h), ctypes.byref(depth)
+    )
+    return (
+        PROBE_FORMATS.get(code, "application/octet-stream"),
+        w.value, h.value, depth.value,
+    )
+
+
+def png_decode(
+    data: bytes, channels: int = 0
+) -> Optional[Tuple[np.ndarray, int]]:
+    """Decode PNG -> ([h, w, ch] uint8, ch). channels: 0 auto, 3 RGB, 4 RGBA."""
+    lib = _load()
+    if not lib:
+        return None
+    w = ctypes.c_int()
+    h = ctypes.c_int()
+    ch = ctypes.c_int()
+    ptr = lib.fc_png_decode(
+        data, len(data), channels,
+        ctypes.byref(w), ctypes.byref(h), ctypes.byref(ch),
+    )
+    if not ptr:
+        return None
+    arr = _take_buffer(lib, ptr, w.value * h.value * ch.value)
+    return arr.reshape(h.value, w.value, ch.value), ch.value
+
+
+def png_encode(pixels: np.ndarray) -> Optional[bytes]:
+    """Encode [h, w, 3|4] uint8 -> PNG bytes."""
+    lib = _load()
+    if not lib:
+        return None
+    pixels = np.ascontiguousarray(pixels, dtype=np.uint8)
+    h, w = pixels.shape[:2]
+    channels = pixels.shape[2] if pixels.ndim == 3 else 1
+    if channels not in (3, 4):
+        return None
+    out_len = ctypes.c_size_t()
+    ptr = lib.fc_png_encode(
+        pixels.tobytes(), w, h, channels, ctypes.byref(out_len)
     )
     if not ptr:
         return None

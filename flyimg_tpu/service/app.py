@@ -77,7 +77,31 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
     )
     handler = ImageHandler(storage, params, batcher=batcher, metrics=metrics)
 
-    app = web.Application(client_max_size=64 * 1024 * 1024)
+    @web.middleware
+    async def request_metrics(request: web.Request, handler):
+        """Count every request by route/status — including unexpected 500s,
+        which are exactly what a metrics endpoint exists to surface.
+        (The `handler` param name is required by aiohttp and shadows the
+        ImageHandler binding only inside this function.)"""
+        route = (
+            request.match_info.route.resource.canonical.strip("/").split("/")[0]
+            if request.match_info.route.resource is not None
+            else "unmatched"
+        ) or "index"
+        try:
+            response = await handler(request)
+        except web.HTTPException as exc:
+            metrics.record_request(route, exc.status)
+            raise
+        except Exception:
+            metrics.record_request(route, 500)
+            raise
+        metrics.record_request(route, response.status)
+        return response
+
+    app = web.Application(
+        client_max_size=64 * 1024 * 1024, middlewares=[request_metrics]
+    )
     app["params"] = params
     app["handler"] = handler
     app["metrics"] = metrics
@@ -108,25 +132,19 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
         try:
             result = await _process(request)
         except AppException as exc:
-            resp = _error_response(exc)
-            metrics.record_request("upload", resp.status)
-            return resp
+            return _error_response(exc)
         headers = image_headers(
             result, params.by_key("header_cache_days", 365)
         )
-        metrics.record_request("upload", 200)
         return web.Response(body=result.content, headers=headers)
 
     async def path(request: web.Request) -> web.Response:
         try:
             result = await _process(request)
         except AppException as exc:
-            resp = _error_response(exc)
-            metrics.record_request("path", resp.status)
-            return resp
+            return _error_response(exc)
         base = f"{request.scheme}://{request.host}"
         url = storage.public_url(result.spec.name, base)
-        metrics.record_request("path", 200)
         return web.Response(text=url)
 
     async def metrics_route(_request: web.Request) -> web.Response:

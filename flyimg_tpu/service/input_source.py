@@ -20,7 +20,7 @@ from typing import Optional
 
 import httpx
 
-from flyimg_tpu.codecs import MediaInfo, sniff
+from flyimg_tpu.codecs import MediaInfo, media_info
 from flyimg_tpu.codecs import pdf as pdf_codec
 from flyimg_tpu.codecs import video as video_codec
 from flyimg_tpu.exceptions import ReadFileException
@@ -112,7 +112,7 @@ def load_source(
     )
     with open(cache_path, "rb") as fh:
         head = fh.read(65536)
-    info = sniff(head)
+    info = media_info(head)
 
     data_path = cache_path
     if info.is_video:

@@ -1,0 +1,191 @@
+"""Host codec layer: round-trips, native/python probe agreement, alpha,
+DCT prescale, and the parallel decode pool.
+
+The reference's codec behavior lives in external binaries (ImageMagick
+decode, cjpeg, cwebp — reference src/Core/Processor/Processor.php:15-33);
+here it is the in-process fastcodec library + PIL fallback, so this suite is
+the conformance net for that replacement.
+"""
+
+import io
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from flyimg_tpu.codecs import decode, encode, sniff
+from flyimg_tpu.codecs import native_codec
+
+
+def _img(h=40, w=56, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+
+
+# ---- encode/decode round trips --------------------------------------------
+
+@pytest.mark.parametrize("fmt,mime", [
+    ("png", "image/png"),
+    ("jpg", "image/jpeg"),
+    ("webp", "image/webp"),
+    ("gif", "image/gif"),
+])
+def test_round_trip_formats(fmt, mime):
+    img = _img()
+    blob = encode(img, fmt, quality=95)
+    assert sniff(blob).mime == mime
+    out = decode(blob)
+    assert out.rgb.shape == img.shape
+    if fmt == "png":  # lossless: exact
+        np.testing.assert_array_equal(out.rgb, img)
+
+
+def test_png_alpha_round_trip():
+    img = _img(seed=1)
+    alpha = np.linspace(0, 255, 40 * 56, dtype=np.uint8).reshape(40, 56)
+    blob = encode(img, "png", alpha=alpha)
+    out = decode(blob)
+    assert out.alpha is not None
+    np.testing.assert_array_equal(out.rgb, img)
+    np.testing.assert_array_equal(out.alpha, alpha)
+
+
+def test_jpeg_quality_orders_size():
+    img = _img(seed=2)
+    small = encode(img, "jpg", quality=30)
+    large = encode(img, "jpg", quality=95)
+    assert len(small) < len(large)
+
+
+def test_webp_lossless_flag():
+    img = _img(seed=3)
+    blob = encode(img, "webp", webp_lossless=True)
+    out = decode(blob)
+    np.testing.assert_array_equal(out.rgb, img)
+
+
+# ---- native probe vs python sniffer ---------------------------------------
+
+def _fixture_blobs():
+    img = _img(seed=4)
+    blobs = {
+        "image/png": encode(img, "png"),
+        "image/jpeg": encode(img, "jpg"),
+        "image/webp": encode(img, "webp"),
+        "image/gif": encode(img, "gif"),
+    }
+    buf = io.BytesIO()
+    Image.fromarray(img).save(buf, "BMP")
+    blobs["image/bmp"] = buf.getvalue()
+    blobs["application/pdf"] = b"%PDF-1.4\n" + b"x" * 64
+    return blobs
+
+
+@pytest.mark.skipif(
+    not native_codec.available(), reason="native codec not built"
+)
+def test_native_probe_agrees_with_python_sniff():
+    for mime, blob in _fixture_blobs().items():
+        head = blob[:65536]
+        info = sniff(head)
+        probed = native_codec.probe(head)
+        assert probed is not None
+        p_mime, p_w, p_h, p_depth = probed
+        assert p_mime == info.mime == mime
+        if info.width is not None:
+            assert (p_w, p_h) == (info.width, info.height), mime
+        if mime in ("image/png", "image/jpeg", "image/webp"):
+            assert p_depth == 8
+
+
+@pytest.mark.skipif(
+    not native_codec.available(), reason="native codec not built"
+)
+def test_native_probe_garbage_and_truncated():
+    assert native_codec.probe(b"")[0] == "application/octet-stream"
+    assert native_codec.probe(b"\x00" * 64)[0] == "application/octet-stream"
+    png_head = encode(_img(), "png")[:13]  # magic only, no IHDR dims
+    mime, w, h, _ = native_codec.probe(png_head)
+    assert mime == "image/png"
+    assert (w, h) == (0, 0)
+
+
+def test_jpeg_fill_bytes_before_marker():
+    """0xFF fill bytes before a marker are legal JPEG; both probers must
+    still find the SOF dims."""
+    blob = encode(_img(), "jpg")
+    sof = max(blob.find(b"\xff\xc0"), blob.find(b"\xff\xc2"))
+    assert sof > 0
+    padded = blob[:sof] + b"\xff" + blob[sof:]  # one fill byte before SOF0
+    info = sniff(padded)
+    assert (info.width, info.height) == (56, 40)
+    if native_codec.available():
+        mime, w, h, depth = native_codec.probe(padded)
+        assert (mime, w, h, depth) == ("image/jpeg", 56, 40, 8)
+
+
+# ---- native PNG specifics --------------------------------------------------
+
+@pytest.mark.skipif(
+    not native_codec.available(), reason="native codec not built"
+)
+def test_native_png_matches_pil():
+    img = _img(seed=5)
+    buf = io.BytesIO()
+    Image.fromarray(img).save(buf, "PNG")
+    decoded = native_codec.png_decode(buf.getvalue())
+    assert decoded is not None
+    pixels, channels = decoded
+    assert channels == 3
+    np.testing.assert_array_equal(pixels, img)
+
+
+@pytest.mark.skipif(
+    not native_codec.available(), reason="native codec not built"
+)
+def test_native_png_palette_transparency():
+    """Palette PNGs with tRNS must surface alpha (the simplified libpng API
+    expands palette + transparency)."""
+    img = Image.fromarray(_img(seed=6)).convert(
+        "P", palette=Image.Palette.ADAPTIVE
+    )
+    img.info["transparency"] = 0
+    buf = io.BytesIO()
+    img.save(buf, "PNG", transparency=0)
+    decoded = native_codec.png_decode(buf.getvalue())
+    assert decoded is not None
+    _, channels = decoded
+    assert channels == 4
+
+
+# ---- DCT prescale hint -----------------------------------------------------
+
+def test_jpeg_decode_prescale_hint():
+    """A small target hint lets the decoder return a DCT-downscaled image
+    (>= 2x the target box), not the full resolution."""
+    img = _img(h=640, w=896, seed=7)
+    blob = encode(img, "jpg", quality=90)
+    full = decode(blob)
+    assert full.rgb.shape[:2] == (640, 896)
+    hinted = decode(blob, target_hint=(100, 100))
+    assert hinted.rgb.shape[0] < 640
+    assert hinted.rgb.shape[0] >= 200  # still >= 2x the 100px target
+
+
+# ---- decode pool -----------------------------------------------------------
+
+@pytest.mark.skipif(
+    not native_codec.available(), reason="native codec not built"
+)
+def test_decode_pool_batch():
+    blobs = [encode(_img(seed=s), "jpg", quality=92) for s in range(6)]
+    blobs.append(b"not a jpeg")
+    pool = native_codec.DecodePool(n_threads=2)
+    try:
+        outs = pool.decode_batch(blobs)
+        assert len(outs) == 7
+        for out in outs[:6]:
+            assert out is not None and out.shape == (40, 56, 3)
+        assert outs[6] is None
+    finally:
+        pool.close()
