@@ -39,7 +39,10 @@ def test_batch_matches_single_path(controller):
         np.testing.assert_array_equal(out, single)
 
 
-def test_mixed_aspect_fit_shares_batch(controller):
+def test_mixed_aspect_fit_shares_batch():
+    # max_batch == number of submits + a long deadline makes the flush
+    # trigger deterministically on batch-full, immune to slow cold starts
+    ctl = BatchController(max_batch=3, deadline_ms=10_000.0)
     futures = []
     expected_shapes = []
     # different aspects, same 128-px input bucket (640 x 512)
@@ -47,11 +50,14 @@ def test_mixed_aspect_fit_shares_batch(controller):
         img = make_test_image(w, h, seed=10 + i)
         plan = _plan("w_300", w, h)
         expected_shapes.append((plan.resize_to[1], plan.resize_to[0], 3))
-        futures.append(controller.submit(img, plan))
-    outs = [f.result(timeout=120) for f in futures]
+        futures.append(ctl.submit(img, plan))
+    try:
+        outs = [f.result(timeout=120) for f in futures]
+    finally:
+        ctl.close()
+    stats = ctl.stats()
     for out, shape in zip(outs, expected_shapes):
         assert out.shape == shape
-    stats = controller.stats()
     # all three different aspects must have run as ONE batch
     assert stats["batches"] == 1
     assert stats["images"] == 3
