@@ -167,6 +167,19 @@ def _conv_scores(field: jnp.ndarray, kernel: jnp.ndarray, stride: int) -> jnp.nd
     return out[0, :, :, 0]
 
 
+def weighted_field(features: jnp.ndarray) -> jnp.ndarray:
+    """Merge the three feature maps with the reference's scoring channel
+    weights into the scalar field candidate scoring convolves over."""
+    skin = features[..., 0] / 255.0
+    detail = features[..., 1] / 255.0
+    sat = features[..., 2] / 255.0
+    return (
+        detail * DETAIL_WEIGHT
+        + skin * (detail + SKIN_BIAS) * SKIN_WEIGHT
+        + sat * (detail + SATURATION_BIAS) * SATURATION_WEIGHT
+    )
+
+
 def score_grid(
     features: jnp.ndarray, crop_w: float, crop_h: float, stride: int = 8
 ) -> jnp.ndarray:
@@ -179,17 +192,15 @@ def score_grid(
     the importance factor is crop-relative (= fixed kernel), and outside
     pixels contribute OUTSIDE_IMPORTANCE * weight.
     """
-    skin = features[..., 0] / 255.0
-    detail = features[..., 1] / 255.0
-    sat = features[..., 2] / 255.0
+    return score_grid_from_weighted(weighted_field(features), crop_w, crop_h, stride)
 
-    # combined per-pixel weight with the reference's channel weights folded in
-    weighted = (
-        detail * DETAIL_WEIGHT
-        + skin * (detail + SKIN_BIAS) * SKIN_WEIGHT
-        + sat * (detail + SATURATION_BIAS) * SATURATION_WEIGHT
-    )
 
+def score_grid_from_weighted(
+    weighted: jnp.ndarray, crop_w: float, crop_h: float, stride: int = 8
+) -> jnp.ndarray:
+    """Candidate scores given a precomputed weighted field (either
+    ``weighted_field(analyse_features(...))`` or the fused Pallas kernel
+    ``ops.pallas_kernels.saliency_field``)."""
     kernel = jnp.asarray(importance_kernel(crop_w, crop_h))
     kh, kw = kernel.shape
     inside = _conv_scores(weighted, kernel, stride)
@@ -213,6 +224,7 @@ def find_best_crop(
     max_scale: float = 1.0,
     scale_step: float = 0.1,
     step: int = 8,
+    use_pallas: bool | None = None,
 ) -> Dict[str, int]:
     """Best crop of [h, w, 3] uint8 -> dict(x, y, width, height), in source
     pixel coords. Mirrors SmartCrop.crop() including prescale bookkeeping."""
@@ -233,7 +245,17 @@ def find_best_crop(
     else:
         prescale_size = 1.0
 
-    features = analyse_features(jnp.asarray(work))
+    # the weighted scoring field, computed ONCE and reused across scales:
+    # fused Pallas stencil kernel where Mosaic compiles it (TPU), XLA
+    # feature-map path elsewhere (interpret-mode pallas is test-only)
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    if use_pallas:
+        from flyimg_tpu.ops.pallas_kernels import saliency_field
+
+        weighted = saliency_field(jnp.asarray(work))
+    else:
+        weighted = weighted_field(analyse_features(jnp.asarray(work)))
 
     work_h, work_w = work.shape[:2]
     best = None
@@ -255,7 +277,7 @@ def find_best_crop(
         max_y = int((work_h - ch) // step) * step
         if max_x < 0 or max_y < 0:
             continue
-        scores = np.asarray(score_grid(features, cw, ch, stride=step))
+        scores = np.asarray(score_grid_from_weighted(weighted, cw, ch, stride=step))
         ny = max_y // step + 1
         nx = max_x // step + 1
         sub = scores[:ny, :nx]
