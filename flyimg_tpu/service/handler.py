@@ -14,9 +14,11 @@ run the device program per frame and re-assemble, replacing the reference's
 
 from __future__ import annotations
 
+import threading
 import time
+from concurrent.futures import Future
 from dataclasses import dataclass, field
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
@@ -30,6 +32,39 @@ from flyimg_tpu.service.security import SecurityHandler
 from flyimg_tpu.spec.options import OptionsBag
 from flyimg_tpu.spec.plan import TransformPlan, build_plan
 from flyimg_tpu.storage.base import Storage
+
+
+class _SingleFlight:
+    """Coalesce concurrent cache-misses for the same output name.
+
+    The reference has a documented race here: N concurrent misses for one
+    key each run the full pipeline and last-write-wins into storage
+    (ImageHandler.php:103-111, see SURVEY.md section 5). Instead, the first
+    thread in becomes the leader and computes; followers block on its
+    future and reuse the bytes — one device pipeline per key, ever.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._inflight: Dict[str, Future] = {}
+
+    def begin(self, key: str) -> Tuple[bool, Future]:
+        """-> (is_leader, future). Leaders MUST call done() exactly once."""
+        with self._lock:
+            fut = self._inflight.get(key)
+            if fut is not None:
+                return False, fut
+            fut = Future()
+            self._inflight[key] = fut
+            return True, fut
+
+    def done(self, key: str, result=None, exc: Optional[BaseException] = None):
+        with self._lock:
+            fut = self._inflight.pop(key)
+        if exc is not None:
+            fut.set_exception(exc)
+        else:
+            fut.set_result(result)
 
 
 @dataclass
@@ -62,6 +97,7 @@ class ImageHandler:
         self.metrics = metrics  # runtime.metrics.MetricsRegistry or None
         self._face_backend = face_backend
         self._smartcrop_backend = smartcrop_backend
+        self._singleflight = _SingleFlight()
 
     # lazily import model backends so the service can run without them
     def _smartcrop(self):
@@ -130,8 +166,33 @@ class ImageHandler:
                 timings=timings,
             )
 
-        content = self._process_new(source.data, options, spec, timings)
-        self.storage.write(spec.name, content)
+        leader, flight = self._singleflight.begin(spec.name)
+        if not leader:
+            # another request is already computing these exact bytes;
+            # wait for it instead of running a duplicate device pipeline
+            content = flight.result()
+            timings["coalesced"] = time.perf_counter() - t0
+            timings["total"] = timings["coalesced"]
+            if self.metrics is not None:
+                # served without running a pipeline: a hit for traffic
+                # accounting, plus the dedicated coalesce counter
+                self.metrics.record_cache(hit=True)
+                self.metrics.record_stage("coalesced", timings["coalesced"])
+                self.metrics.counter(
+                    "flyimg_requests_coalesced_total",
+                    "Cache-miss requests served by an in-flight duplicate",
+                ).inc()
+            return ProcessedImage(
+                content=content, spec=spec, options=options, timings=timings
+            )
+
+        try:
+            content = self._process_new(source.data, options, spec, timings)
+            self.storage.write(spec.name, content)
+        except BaseException as exc:
+            self._singleflight.done(spec.name, exc=exc)
+            raise
+        self._singleflight.done(spec.name, result=content)
         timings["total"] = time.perf_counter() - t0
         if self.metrics is not None:
             self.metrics.record_cache(hit=False)

@@ -15,6 +15,7 @@ before decoding (InputImage.php:61-68), via the gated ingestion backends.
 from __future__ import annotations
 
 import os
+import threading
 from dataclasses import dataclass
 from typing import Optional
 
@@ -87,10 +88,20 @@ def fetch_original(
     if len(data) > MAX_SOURCE_BYTES:
         raise ReadFileException(f"source exceeds {MAX_SOURCE_BYTES} bytes")
 
-    tmp = cache_path + ".part"
-    with open(tmp, "wb") as fh:
-        fh.write(data)
-    os.replace(tmp, cache_path)
+    # unique temp per writer: concurrent fetches of the same URL must not
+    # share a .part file (the loser's os.replace would find it gone); the
+    # atomic rename keeps readers consistent whichever writer lands last
+    tmp = f"{cache_path}.part-{os.getpid()}-{threading.get_ident()}"
+    try:
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+        os.replace(tmp, cache_path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
     return cache_path
 
 

@@ -211,3 +211,116 @@ def test_restricted_domains_enforced(tmp_path):
     handler = ImageHandler(make_storage(params), params)
     with pytest.raises(SecurityException):
         handler.process_image("w_50", "https://evil.com/x.png")
+
+
+def test_concurrent_misses_coalesce_to_one_pipeline(env, monkeypatch):
+    """N concurrent cache-misses for one key run ONE device pipeline; the
+    rest wait on the in-flight result (the reference instead raced all N,
+    last-write-wins — SURVEY.md section 5)."""
+    import threading
+
+    handler, storage, tmp = env
+    src = _write_jpg(tmp / "coalesce.jpg")
+
+    calls = []
+    barrier = threading.Barrier(4, timeout=10)
+    real = handler._process_new
+
+    def slow_process(data, options, spec, timings):
+        calls.append(1)
+        import time as _t
+
+        _t.sleep(0.2)  # hold the leader open so followers pile up
+        return real(data, options, spec, timings)
+
+    monkeypatch.setattr(handler, "_process_new", slow_process)
+
+    results = [None] * 4
+    errors = []
+
+    def worker(i):
+        try:
+            barrier.wait()
+            results[i] = handler.process_image("w_120,h_80,rz_1", src)
+        except Exception as exc:  # pragma: no cover
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    assert not errors
+    assert len(calls) == 1, "duplicate pipelines ran for one cache key"
+    contents = {r.content for r in results}
+    assert len(contents) == 1
+    coalesced = [r for r in results if "coalesced" in r.timings]
+    assert len(coalesced) == 3
+
+
+def test_leader_failure_propagates_to_followers(env, monkeypatch):
+    import threading
+
+    handler, storage, tmp = env
+    src = _write_jpg(tmp / "coalesce_fail.jpg")
+
+    barrier = threading.Barrier(2, timeout=10)
+
+    def broken_process(data, options, spec, timings):
+        import time as _t
+
+        _t.sleep(0.2)
+        raise RuntimeError("device exploded")
+
+    monkeypatch.setattr(handler, "_process_new", broken_process)
+
+    errors = []
+
+    def worker():
+        try:
+            barrier.wait()
+            handler.process_image("w_121,h_80,rz_1", src)
+        except Exception as exc:
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    assert len(errors) == 2  # leader raises AND the follower sees it
+    # the in-flight table is clean: a retry works once the fault clears
+    monkeypatch.setattr(handler, "_process_new", ImageHandler._process_new.__get__(handler))
+    out = handler.process_image("w_121,h_80,rz_1", src)
+    assert out.content
+
+
+def test_concurrent_source_fetches_do_not_race(env):
+    """Concurrent first-time fetches of the same source must all succeed
+    (each writer gets a private temp file; atomic rename is last-wins)."""
+    import threading
+
+    from flyimg_tpu.service.input_source import fetch_original
+
+    handler, storage, tmp = env
+    src = _write_jpg(tmp / "racefetch.jpg")
+    tmp_dir = str(tmp / "tmp")
+
+    barrier = threading.Barrier(6, timeout=10)
+    errors = []
+
+    def worker():
+        try:
+            barrier.wait()
+            fetch_original(src, tmp_dir)
+        except Exception as exc:
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
