@@ -26,6 +26,7 @@ from typing import Optional, Tuple
 from flyimg_tpu.spec.colors import parse_color
 from flyimg_tpu.spec.geometry import (
     GeometryPlan,
+    _round_dim,
     gravity_offset,
     parse_extent,
     resolve_geometry,
@@ -190,6 +191,20 @@ def rotated_bounds(w: int, h: int, degrees: float) -> Tuple[int, int]:
     return (max(new_w, 1), max(new_h, 1))
 
 
+def _parse_scale(value: object) -> Optional[float]:
+    """sc_N -> percentage; accepts '50' or '50%'. Non-positive/garbage -> None."""
+    if value in (None, "", False):
+        return None
+    text = str(value).strip().rstrip("%")
+    try:
+        pct = float(text)
+    except (TypeError, ValueError):
+        return None
+    if not math.isfinite(pct) or pct <= 0.0:
+        return None
+    return pct
+
+
 def _parse_rotate(value: object) -> Optional[float]:
     if value in (None, "", False):
         return None
@@ -242,6 +257,23 @@ def build_plan(
             if x1 > x0 and y1 > y0:
                 extract = (x0, y0, x1, y1)
                 eff_w, eff_h = x1 - x0, y1 - y0
+
+    # sc_N: percentage scaling (docs/url-options.md). The reference parses
+    # this option but never emits IM's -scale (latent dead code, like the
+    # `thread` flag — SURVEY.md section 2.4); here it scales the requested
+    # target — or, with no w/h, the post-extract source dims. Explicit
+    # scaling means upscaling is intended, so it bypasses the pns
+    # no-upscale rule. IM dimension rounding (_round_dim) throughout.
+    scale_pct = _parse_scale(options.get_option("scale"))
+    if scale_pct is not None:
+        factor = scale_pct / 100.0
+        if width or height:
+            width = max(1, _round_dim(width * factor)) if width else None
+            height = max(1, _round_dim(height * factor)) if height else None
+        else:
+            width = max(1, _round_dim(eff_w * factor))
+            height = max(1, _round_dim(eff_h * factor))
+        pns = False
 
     geometry: GeometryPlan = resolve_geometry(
         eff_w, eff_h, width, height,

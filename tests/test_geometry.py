@@ -154,3 +154,38 @@ def test_gravity_offsets():
     assert gravity_offset(450, 300, 300, 300, "East") == (150, 0)
     assert gravity_offset(300, 450, 300, 300, "South") == (0, 150)
     assert gravity_offset(300, 451, 300, 300, "Center") == (0, 75)
+
+
+def test_scale_percent_of_source():
+    # sc_N with no w/h: percentage of the source dims (docs/url-options.md).
+    # The reference parses `scale` but never applies it (latent dead code);
+    # here it works as its docs promise.
+    assert _final_size("sc_50", (1000, 600)) == "500x300"
+    assert _final_size("sc_25", (1000, 600)) == "250x150"
+
+
+def test_scale_scales_requested_target():
+    assert _final_size("w_400,h_300,sc_50", (1000, 750)) == "200x150"
+
+
+def test_scale_can_upscale():
+    # explicit scaling bypasses the pns no-upscale default
+    assert _final_size("sc_200", (100, 80)) == "200x160"
+
+
+def test_scale_garbage_ignored():
+    assert _final_size("sc_abc", (1000, 600)) == "1000x600"
+    assert _final_size("sc_-5", (1000, 600)) == "1000x600"
+    assert _final_size("sc_0", (1000, 600)) == "1000x600"
+
+
+def test_scale_after_extract_uses_region_dims():
+    # sc with e_1 scales the EXTRACTED region, not the full source
+    assert _final_size(
+        "e_1,p1x_0,p1y_0,p2x_200,p2y_100,sc_50", (1000, 600)
+    ) == "100x50"
+
+
+def test_scale_uses_im_rounding():
+    # floor(x+0.5), not banker's: 25*0.5 = 12.5 -> 13
+    assert _final_size("w_25,sc_50,pns_0", (1000, 600)) == "13x8"

@@ -1,0 +1,226 @@
+"""HTTP serving benchmark — the reference `benchmark.sh` analog.
+
+The reference's published numbers are a vegeta run: 50 req/s for 10 s
+against one image for three option sets (crop / resize / rotate), measuring
+the cache-hit serving path after the first miss (README.md:548-587,
+BASELINE.md). This harness reproduces that methodology against the live
+service, plus an uncapped burst mode that reports max sustained cache-hit
+throughput.
+
+Usage:
+    python tools/bench_http.py [--base http://host:port] [--rate 50]
+                               [--duration 10] [--burst 2000]
+
+With --base, benchmarks that already-running service. Without it (or with
+--spawn), starts the service on a free port and shuts it down after; the
+two flags together are contradictory and rejected. Prints one human table
+and one JSON line per scenario.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import httpx
+import numpy as np
+
+SCENARIOS = [
+    ("crop", "w_200,h_200,c_1"),
+    ("resize", "w_200,h_200,rz_1"),
+    ("rotate", "r_-45,w_400,h_400"),
+]
+
+
+def _make_source(path: str) -> str:
+    from PIL import Image
+
+    if not os.path.exists(path):
+        rng = np.random.default_rng(42)
+        arr = rng.integers(0, 256, size=(768, 1024, 3), dtype=np.uint8)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        Image.fromarray(arr).save(path, "JPEG", quality=92)
+    return path
+
+
+async def _rated_run(
+    client: httpx.AsyncClient, url: str, rate: float, duration: float
+):
+    """Fire GETs at a fixed rate (vegeta-style open-loop), gather latencies."""
+    latencies: list = []
+    failures = 0
+    tasks = []
+
+    async def one():
+        nonlocal failures
+        t0 = time.perf_counter()
+        try:
+            resp = await client.get(url)
+            ok = resp.status_code == 200 and len(resp.content) > 0
+        except httpx.HTTPError:
+            ok = False
+        if ok:
+            latencies.append(time.perf_counter() - t0)
+        else:
+            failures += 1
+
+    start = time.perf_counter()
+    n = int(rate * duration)
+    for i in range(n):
+        target = start + i / rate
+        delay = target - time.perf_counter()
+        if delay > 0:
+            await asyncio.sleep(delay)
+        tasks.append(asyncio.ensure_future(one()))
+    await asyncio.gather(*tasks)
+    elapsed = time.perf_counter() - start
+    return latencies, failures, elapsed
+
+
+async def _burst_run(client: httpx.AsyncClient, url: str, total: int, conc: int):
+    """Closed-loop max throughput: `conc` in-flight workers, `total` reqs."""
+    latencies: list = []
+    failures = 0
+    remaining = [total]
+
+    async def worker():
+        nonlocal failures
+        while True:
+            if remaining[0] <= 0:
+                return
+            remaining[0] -= 1
+            t0 = time.perf_counter()
+            try:
+                resp = await client.get(url)
+                ok = resp.status_code == 200
+            except httpx.HTTPError:
+                ok = False
+            if ok:
+                latencies.append(time.perf_counter() - t0)
+            else:
+                failures += 1
+
+    start = time.perf_counter()
+    await asyncio.gather(*[worker() for _ in range(conc)])
+    elapsed = time.perf_counter() - start
+    return latencies, failures, elapsed
+
+
+def _report(name: str, mode: str, lat, failures: int, elapsed: float):
+    if not lat:
+        print(f"{name:8s} {mode:6s}  ALL {failures} REQUESTS FAILED")
+        return
+    arr = np.asarray(lat) * 1000.0
+    row = {
+        "scenario": name,
+        "mode": mode,
+        "requests": len(lat) + failures,
+        "success_rate": round(len(lat) / (len(lat) + failures), 4),
+        "throughput_rps": round(len(lat) / elapsed, 1),
+        "latency_ms": {
+            "mean": round(float(arr.mean()), 2),
+            "p50": round(float(np.percentile(arr, 50)), 2),
+            "p95": round(float(np.percentile(arr, 95)), 2),
+            "p99": round(float(np.percentile(arr, 99)), 2),
+            "max": round(float(arr.max()), 2),
+        },
+    }
+    print(
+        f"{name:8s} {mode:6s}  {row['throughput_rps']:8.1f} req/s   "
+        f"mean {row['latency_ms']['mean']:7.2f}  p50 {row['latency_ms']['p50']:7.2f}  "
+        f"p95 {row['latency_ms']['p95']:7.2f}  p99 {row['latency_ms']['p99']:7.2f}  "
+        f"max {row['latency_ms']['max']:8.2f} ms   "
+        f"ok {row['success_rate'] * 100:.1f}%"
+    )
+    print(json.dumps(row))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+async def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--base", default=None, help="base URL of a running service")
+    ap.add_argument("--rate", type=float, default=50.0)
+    ap.add_argument("--duration", type=float, default=10.0)
+    ap.add_argument("--burst", type=int, default=2000, help="burst request count (0=skip)")
+    ap.add_argument("--conc", type=int, default=32, help="burst concurrency")
+    ap.add_argument("--spawn", action="store_true", help="start the service here")
+    ap.add_argument("--source", default="var/tmp/bench-source.jpg")
+    args = ap.parse_args()
+
+    if args.base and args.spawn:
+        print("--base and --spawn are mutually exclusive", file=sys.stderr)
+        return 2
+
+    proc = None
+    base = args.base
+    if base is None:
+        port = _free_port()
+        base = f"http://127.0.0.1:{port}"
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "flyimg_tpu.service.app", "serve",
+             "--port", str(port)],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+
+    src = _make_source(args.source)
+    rc = 0
+    try:
+        async with httpx.AsyncClient(
+            timeout=60.0, limits=httpx.Limits(max_connections=256)
+        ) as client:
+            # wait for readiness
+            for _ in range(120):
+                try:
+                    r = await client.get(f"{base}/healthz")
+                    if r.status_code == 200:
+                        break
+                except httpx.HTTPError:
+                    pass
+                await asyncio.sleep(1.0)
+            else:
+                print("service never became healthy", file=sys.stderr)
+                return 1
+
+            print(f"target {base}  rate {args.rate} req/s x {args.duration}s "
+                  f"+ burst {args.burst} @ conc {args.conc}")
+            for name, options in SCENARIOS:
+                url = f"{base}/upload/{options}/{src}"
+                warm = await client.get(url)   # first miss computes
+                if warm.status_code != 200:
+                    print(f"{name}: warmup failed ({warm.status_code})")
+                    rc = 1
+                    continue
+                lat, fails, elapsed = await _rated_run(
+                    client, url, args.rate, args.duration
+                )
+                _report(name, "rated", lat, fails, elapsed)
+                if args.burst:
+                    lat, fails, elapsed = await _burst_run(
+                        client, url, args.burst, args.conc
+                    )
+                    _report(name, "burst", lat, fails, elapsed)
+    finally:
+        if proc is not None:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(asyncio.run(main()))
