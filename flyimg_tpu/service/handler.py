@@ -30,7 +30,7 @@ from flyimg_tpu.service.input_source import load_source
 from flyimg_tpu.service.output_image import OutputSpec, resolve_output
 from flyimg_tpu.service.security import SecurityHandler
 from flyimg_tpu.spec.options import OptionsBag
-from flyimg_tpu.spec.plan import TransformPlan, build_plan
+from flyimg_tpu.spec.plan import TransformPlan, build_plan, decode_target_hint
 from flyimg_tpu.storage.base import Storage
 
 
@@ -216,10 +216,8 @@ class ImageHandler:
         t = time.perf_counter()
 
         is_animated_gif_out = spec.is_gif
-        # decode target hint for JPEG DCT prescale: the requested box
-        tw = options.int_option("width")
-        th = options.int_option("height")
-        hint = (tw or th, th or tw) if (tw or th) else None
+        # decode target hint for JPEG DCT prescale (scale-aware)
+        hint = decode_target_hint(options)
 
         gif_frame = options.int_option("gif-frame", 0) or 0
         decoded = decode(data, target_hint=hint, frame=gif_frame)

@@ -191,6 +191,24 @@ def rotated_bounds(w: int, h: int, degrees: float) -> Tuple[int, int]:
     return (max(new_w, 1), max(new_h, 1))
 
 
+def decode_target_hint(options: OptionsBag) -> Optional[Tuple[int, int]]:
+    """The (w, h) box the decoder may prescale toward (JPEG DCT-domain
+    scaling). Accounts for sc_N so an upscaling request never decodes below
+    the final target — the decode must stay >= 2x the device resample's
+    output for the resample to be quality-determining."""
+    tw = options.int_option("width")
+    th = options.int_option("height")
+    if not (tw or th):
+        return None
+    w, h = (tw or th), (th or tw)
+    pct = _parse_scale(options.get_option("scale"))
+    if pct is not None:
+        factor = pct / 100.0
+        w = _round_dim(w * factor)
+        h = _round_dim(h * factor)
+    return (w, h)
+
+
 def _parse_scale(value: object) -> Optional[float]:
     """sc_N -> percentage; accepts '50' or '50%'. Non-positive/garbage -> None."""
     if value in (None, "", False):
@@ -268,11 +286,11 @@ def build_plan(
     if scale_pct is not None:
         factor = scale_pct / 100.0
         if width or height:
-            width = max(1, _round_dim(width * factor)) if width else None
-            height = max(1, _round_dim(height * factor)) if height else None
+            width = _round_dim(width * factor) if width else None
+            height = _round_dim(height * factor) if height else None
         else:
-            width = max(1, _round_dim(eff_w * factor))
-            height = max(1, _round_dim(eff_h * factor))
+            width = _round_dim(eff_w * factor)
+            height = _round_dim(eff_h * factor)
         pns = False
 
     geometry: GeometryPlan = resolve_geometry(

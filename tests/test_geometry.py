@@ -189,3 +189,14 @@ def test_scale_after_extract_uses_region_dims():
 def test_scale_uses_im_rounding():
     # floor(x+0.5), not banker's: 25*0.5 = 12.5 -> 13
     assert _final_size("w_25,sc_50,pns_0", (1000, 600)) == "13x8"
+
+
+def test_decode_hint_accounts_for_scale():
+    from flyimg_tpu.spec.plan import decode_target_hint
+
+    assert decode_target_hint(OptionsBag("w_200")) == (200, 200)
+    assert decode_target_hint(OptionsBag("w_200,h_100")) == (200, 100)
+    # sc_300 triples the real target; the decode hint must follow so the
+    # DCT prescale never under-decodes an upscaling request
+    assert decode_target_hint(OptionsBag("w_200,sc_300")) == (600, 600)
+    assert decode_target_hint(OptionsBag("sc_50")) is None

@@ -45,7 +45,9 @@ def _make_source(path: str) -> str:
     if not os.path.exists(path):
         rng = np.random.default_rng(42)
         arr = rng.integers(0, 256, size=(768, 1024, 3), dtype=np.uint8)
-        os.makedirs(os.path.dirname(path), exist_ok=True)
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
         Image.fromarray(arr).save(path, "JPEG", quality=92)
     return path
 
@@ -201,6 +203,13 @@ async def main() -> int:
                 warm = await client.get(url)   # first miss computes
                 if warm.status_code != 200:
                     print(f"{name}: warmup failed ({warm.status_code})")
+                    if args.base and "://" not in args.source:
+                        print(
+                            "  note: with --base, --source is resolved by "
+                            "the TARGET service (relative to its cwd); pass "
+                            "a URL or a path that exists on the service host",
+                            file=sys.stderr,
+                        )
                     rc = 1
                     continue
                 lat, fails, elapsed = await _rated_run(
