@@ -56,11 +56,23 @@ def build_batched_program(
     pad_canvas: Optional[Tuple[int, int]],
     pad_offset: Tuple[int, int],
     plan: TransformPlan,
+    mesh=None,
 ):
-    """vmap of the single-image program over a static batch axis."""
+    """vmap of the single-image program over a static batch axis; with a
+    mesh, the batch axis is sharded over its 'data' axis (SPMD fan-out, no
+    collectives — each device transforms its slice of the batch)."""
     del batch_size, in_shape  # cache-key components; jit re-specializes
     inner = make_program_fn(resample_out, pad_canvas, pad_offset, plan)
-    return jax.jit(jax.vmap(inner))
+    if mesh is None:
+        return jax.jit(jax.vmap(inner))
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P("data"))
+    return jax.jit(
+        jax.vmap(inner),
+        in_shardings=(sharding,) * 5,
+        out_shardings=sharding,
+    )
 
 
 @dataclass
@@ -93,11 +105,19 @@ class BatchController:
         max_batch: int = 64,
         deadline_ms: float = 4.0,
         metrics=None,
+        mesh=None,
     ) -> None:
         from flyimg_tpu.runtime.metrics import MetricsRegistry
 
         self.max_batch = max_batch
         self.deadline_s = deadline_ms / 1000.0
+        # optional data-parallel mesh: batches shard over its 'data' axis
+        self.mesh = mesh
+        self._n_devices = 1
+        if mesh is not None:
+            if "data" not in mesh.axis_names:
+                raise ValueError("batcher mesh needs a 'data' axis")
+            self._n_devices = int(mesh.shape["data"])
         # single source of truth for batch accounting; the app passes its
         # shared registry, standalone use gets a private one
         self.metrics = metrics if metrics is not None else MetricsRegistry()
@@ -275,7 +295,12 @@ class BatchController:
     def _execute(self, group: _Group) -> None:
         members = group.members
         n = len(members)
+        # sharded execution needs the batch divisible by the data axis —
+        # round the ladder size up to a multiple of it (device counts are
+        # not necessarily powers of two)
         batch = _round_batch(n)
+        nd = self._n_devices
+        batch = -(-batch // nd) * nd
         try:
             bh, bw = group.in_shape
             images = np.zeros((batch, bh, bw, 3), dtype=np.uint8)
@@ -314,6 +339,7 @@ class BatchController:
                 group.pad_canvas,
                 group.pad_offset,
                 group.device_plan,
+                self.mesh,
             )
             out = np.asarray(
                 fn(

@@ -70,10 +70,20 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
     from flyimg_tpu.runtime.metrics import MetricsRegistry
 
     metrics = MetricsRegistry()
+    # with more than one chip, shard every batch over a data-parallel mesh
+    # (SPMD fan-out — the v4-8 serving story; parallel/mesh.py)
+    mesh = None
+    import jax
+
+    if len(jax.devices()) > 1:
+        from flyimg_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh()
     batcher = BatchController(
         max_batch=int(params.by_key("batch_max_size", 64)),
         deadline_ms=float(params.by_key("batch_deadline_ms", 4.0)),
         metrics=metrics,
+        mesh=mesh,
     )
     handler = ImageHandler(storage, params, batcher=batcher, metrics=metrics)
 

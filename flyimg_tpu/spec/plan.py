@@ -198,6 +198,9 @@ def decode_target_hint(options: OptionsBag) -> Optional[Tuple[int, int]]:
     output for the resample to be quality-determining."""
     tw = options.int_option("width")
     th = options.int_option("height")
+    # same sanitization as build_plan: non-positive target dims are unset
+    tw = tw if tw and tw > 0 else None
+    th = th if th and th > 0 else None
     if not (tw or th):
         return None
     w, h = (tw or th), (th or tw)

@@ -85,3 +85,86 @@ def test_different_ops_in_different_groups(controller):
     out_b = fb.result(timeout=120)
     np.testing.assert_array_equal(out_a[..., 0], out_a[..., 1])
     assert not np.array_equal(out_b[..., 0], out_b[..., 1])
+
+
+def test_mesh_sharded_batch_matches_unsharded():
+    """A data-parallel mesh batcher returns the same pixels as the
+    single-device path, with batches padded to the device count."""
+    import jax
+
+    from flyimg_tpu.parallel.mesh import make_mesh
+    from flyimg_tpu.spec.options import OptionsBag
+    from flyimg_tpu.spec.plan import build_plan
+
+    mesh = make_mesh()  # 8 virtual CPU devices, axis 'data'
+    plain = BatchController(max_batch=8, deadline_ms=5.0)
+    sharded = BatchController(max_batch=8, deadline_ms=5.0, mesh=mesh)
+    try:
+        rng = np.random.default_rng(5)
+        imgs = [
+            rng.integers(0, 256, size=(96, 128, 3), dtype=np.uint8)
+            for _ in range(8)
+        ]
+        plans = [build_plan(OptionsBag("w_64,h_48,c_1"), 128, 96) for _ in imgs]
+        want = [f.result(timeout=60) for f in
+                [plain.submit(im, pl) for im, pl in zip(imgs, plans)]]
+        got = [f.result(timeout=60) for f in
+               [sharded.submit(im, pl) for im, pl in zip(imgs, plans)]]
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(w, g)
+    finally:
+        plain.close()
+        sharded.close()
+
+
+def test_mesh_single_item_pads_to_device_count():
+    from flyimg_tpu.parallel.mesh import make_mesh
+    from flyimg_tpu.spec.options import OptionsBag
+    from flyimg_tpu.spec.plan import build_plan
+
+    mesh = make_mesh()
+    ctrl = BatchController(max_batch=8, deadline_ms=2.0, mesh=mesh)
+    try:
+        rng = np.random.default_rng(6)
+        img = rng.integers(0, 256, size=(64, 64, 3), dtype=np.uint8)
+        plan = build_plan(OptionsBag("w_32,h_32,rz_1"), 64, 64)
+        out = ctrl.submit(img, plan).result(timeout=60)
+        assert out.shape == (32, 32, 3)
+        stats = ctrl.stats()
+        # 1 real image in an 8-slot (device-count) batch
+        assert stats["images"] == 1
+        assert stats["mean_occupancy"] == pytest.approx(1 / 8)
+    finally:
+        ctrl.close()
+
+
+def test_mesh_without_data_axis_rejected():
+    from flyimg_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(axis_names=("sp",))
+    with pytest.raises(ValueError):
+        BatchController(mesh=mesh)
+
+
+def test_mesh_nonpow2_device_count_rounds_batch():
+    """A 6-device data axis must still get divisible batches (5 -> 12)."""
+    import jax
+
+    from flyimg_tpu.parallel.mesh import make_mesh
+    from flyimg_tpu.spec.options import OptionsBag
+    from flyimg_tpu.spec.plan import build_plan
+
+    mesh = make_mesh((6,), ("data",), devices=jax.devices()[:6])
+    ctrl = BatchController(max_batch=8, deadline_ms=5.0, mesh=mesh)
+    try:
+        rng = np.random.default_rng(7)
+        imgs = [
+            rng.integers(0, 256, size=(64, 64, 3), dtype=np.uint8)
+            for _ in range(5)
+        ]
+        plans = [build_plan(OptionsBag("w_32,h_32,rz_1"), 64, 64) for _ in imgs]
+        outs = [f.result(timeout=60) for f in
+                [ctrl.submit(im, pl) for im, pl in zip(imgs, plans)]]
+        assert all(o.shape == (32, 32, 3) for o in outs)
+    finally:
+        ctrl.close()

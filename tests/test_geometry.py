@@ -200,3 +200,11 @@ def test_decode_hint_accounts_for_scale():
     # DCT prescale never under-decodes an upscaling request
     assert decode_target_hint(OptionsBag("w_200,sc_300")) == (600, 600)
     assert decode_target_hint(OptionsBag("sc_50")) is None
+
+
+def test_decode_hint_rejects_nonpositive_dims():
+    from flyimg_tpu.spec.plan import decode_target_hint
+
+    assert decode_target_hint(OptionsBag("w_-5")) is None
+    assert decode_target_hint(OptionsBag("w_0,h_-3")) is None
+    assert decode_target_hint(OptionsBag("w_-5,h_100")) == (100, 100)

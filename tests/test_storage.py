@@ -1,0 +1,144 @@
+"""Storage layer: local provider semantics + the S3 provider tested without
+AWS (reference strategy: S3StorageProviderTest asserts the URL pattern with
+dummy creds and that SDK failures bubble — no fake S3)."""
+
+import sys
+import types
+
+import pytest
+
+from flyimg_tpu.appconfig import AppParameters
+from flyimg_tpu.exceptions import MissingParamsException
+from flyimg_tpu.storage import make_storage
+from flyimg_tpu.storage.local import LocalStorage
+
+
+@pytest.fixture()
+def local(tmp_path):
+    params = AppParameters({"upload_dir": str(tmp_path / "up")})
+    return make_storage(params)
+
+
+def test_make_storage_defaults_to_local(local):
+    assert isinstance(local, LocalStorage)
+
+
+def test_local_roundtrip(local):
+    assert not local.has("abc.jpg")
+    local.write("abc.jpg", b"bytes")
+    assert local.has("abc.jpg")
+    assert local.read("abc.jpg") == b"bytes"
+    local.delete("abc.jpg")
+    assert not local.has("abc.jpg")
+    local.delete("abc.jpg")  # idempotent
+
+
+def test_local_overwrite_is_atomic_last_wins(local):
+    local.write("k.jpg", b"one")
+    local.write("k.jpg", b"twotwo")
+    assert local.read("k.jpg") == b"twotwo"
+
+
+def test_local_name_traversal_is_neutralized(local, tmp_path):
+    """Content-addressed names are never trusted as paths."""
+    local.write("../../evil.jpg", b"x")
+    assert (tmp_path / "up" / "evil.jpg").exists()
+    assert not (tmp_path / "evil.jpg").exists()
+
+
+def test_local_public_url_request_base(local, monkeypatch):
+    monkeypatch.delenv("HOSTNAME_URL", raising=False)
+    url = local.public_url("abc.jpg", "http://example.com:8080")
+    assert url == "http://example.com:8080/uploads/abc.jpg"
+
+
+def test_local_public_url_hostname_env_wins(local, monkeypatch):
+    monkeypatch.setenv("HOSTNAME_URL", "https://cdn.example.com/")
+    url = local.public_url("abc.jpg", "http://ignored")
+    assert url == "https://cdn.example.com/uploads/abc.jpg"
+
+
+# ---------------------------------------------------------------------------
+# S3 without AWS
+# ---------------------------------------------------------------------------
+
+
+S3_CONF = {
+    "storage_system": "s3",
+    "aws_s3": {
+        "access_id": "AKIA_TEST",
+        "secret_key": "secret",
+        "region": "eu-west-1",
+        "bucket_name": "imgs",
+    },
+}
+
+
+def test_s3_missing_creds_raises():
+    params = AppParameters({"storage_system": "s3", "aws_s3": {"region": "x"}})
+    with pytest.raises(MissingParamsException):
+        make_storage(params)
+
+
+def test_s3_missing_boto3_raises(monkeypatch):
+    monkeypatch.setitem(sys.modules, "boto3", None)  # import -> None -> fails
+    params = AppParameters(dict(S3_CONF))
+    with pytest.raises(MissingParamsException):
+        make_storage(params)
+
+
+class _FakeClient:
+    """In-memory stand-in for boto3's S3 client (head/get/put/delete)."""
+
+    def __init__(self):
+        self.blobs = {}
+
+    def head_object(self, Bucket, Key):
+        if Key not in self.blobs:
+            raise RuntimeError("404")
+        return {}
+
+    def get_object(self, Bucket, Key):
+        data = self.blobs[Key]
+        return {"Body": types.SimpleNamespace(read=lambda: data)}
+
+    def put_object(self, Bucket, Key, Body):
+        self.blobs[Key] = Body
+
+    def delete_object(self, Bucket, Key):
+        self.blobs.pop(Key, None)
+
+
+@pytest.fixture()
+def s3(monkeypatch):
+    fake_boto3 = types.ModuleType("boto3")
+    client = _FakeClient()
+    fake_boto3.client = lambda *a, **k: client
+    monkeypatch.setitem(sys.modules, "boto3", fake_boto3)
+    storage = make_storage(AppParameters(dict(S3_CONF)))
+    return storage, client
+
+
+def test_s3_public_url_pattern(s3):
+    storage, _ = s3
+    assert (
+        storage.public_url("abc.jpg")
+        == "https://s3.eu-west-1.amazonaws.com/imgs/abc.jpg"
+    )
+
+
+def test_s3_roundtrip_via_client(s3):
+    storage, client = s3
+    assert not storage.has("k.webp")
+    storage.write("k.webp", b"payload")
+    assert client.blobs["k.webp"] == b"payload"
+    assert storage.has("k.webp")
+    assert storage.read("k.webp") == b"payload"
+    storage.delete("k.webp")
+    assert not storage.has("k.webp")
+
+
+def test_s3_read_failure_bubbles(s3):
+    storage, _ = s3
+    with pytest.raises(KeyError):
+        storage.read("missing.jpg")
