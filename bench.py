@@ -2,15 +2,20 @@
 
 The BASELINE.json headline workload ("images/sec/chip (resize+smart-crop)"):
 batches of 512x512 uint8 images through the fused device program — windowed
-crop-fill resample to 300x250 (MXU einsums, bf16 multiplies) + the
-smart-crop feature maps and candidate-scoring conv — measured at steady
-state after a warmup compile, with inputs device-resident.
+crop-fill resample to 300x250 (MXU einsums, bf16 multiplies), the
+smart-crop saliency field (Pallas stencil kernel on TPU), and the
+candidate-scoring conv — measured at steady state, inputs device-resident.
 
-Host<->device transfer is excluded on purpose: this environment reaches the
-chip through a relay tunnel moving ~25 MB/s (measured), a dev-harness
-artifact three orders of magnitude below real TPU DMA; including it would
-benchmark the tunnel, not the chip. At real interconnect rates the 50 MB
-batch H2D adds ~5 ms/batch (~10% at current compute speed).
+Measurement model: K batches per device launch via ``lax.scan`` (one
+dispatch, K sequential batch programs), median over several launches. This
+amortizes host dispatch, which in this dev harness crosses a relay tunnel
+with a measured ~71 ms floor per launch — three orders of magnitude above
+real TPU dispatch (~100 us). Per-call blocking would benchmark the tunnel
+(3.2k img/s, all latency); async pipelined dispatch reaches 11.7k; the
+scan steady state is what the same program sustains on real hardware,
+where dispatch overlaps compute. Host<->device transfer is likewise
+excluded: at real interconnect rates the uint8 batch H2D adds ~2 ms/batch
+and overlaps via double buffering.
 
 vs_baseline: BASELINE.md's target is >= 10_000 images/sec on a v4-8 (8
 chips) => 1_250 images/sec/chip; the printed ratio is value / 1250. (The
@@ -26,38 +31,60 @@ import time
 import numpy as np
 
 BATCH = 256
-STEPS = 12
+SCAN_LEN = 10          # batches per device launch
+LAUNCHES = 6
 WARMUP = 2
 TARGET_PER_CHIP = 10_000 / 8.0
 
 
 def main() -> None:
     import jax
+    import jax.numpy as jnp
 
     import __graft_entry__ as graft
 
+    global BATCH, SCAN_LEN, LAUNCHES
+    if jax.default_backend() != "tpu":
+        # CI smoke on CPU: same program, toy sizes
+        BATCH, SCAN_LEN, LAUNCHES = 16, 2, 2
+
     fn, args = graft.entry()
     # scale example args up to the bench batch
-    reps = BATCH // args[0].shape[0]
+    reps = max(BATCH // args[0].shape[0], 1)
+    BATCH = reps * args[0].shape[0]
     device_args = [
         jax.device_put(np.concatenate([np.asarray(a)] * reps, axis=0))
         for a in args
     ]
 
-    jitted = jax.jit(fn)
-    out = jitted(*device_args)
-    jax.block_until_ready(out)  # warmup compile
+    def body(carry, _):
+        # tie each iteration's INPUT to the carry so XLA cannot hoist the
+        # loop-invariant pipeline out of the scan (LICM would otherwise
+        # compute one batch and loop over scalar adds). isnan(carry) is 0
+        # at runtime but data-dependent, so images ^ 0 defeats CSE/LICM
+        # while leaving the pixels untouched.
+        zero = jnp.isnan(carry).astype(jnp.uint8)
+        imgs = device_args[0] ^ zero
+        out, scores = fn(imgs, *device_args[1:])
+        # consume both outputs so no batch is dead-code-eliminated
+        return carry + scores.sum() + out[..., 0].astype(jnp.float32).sum(), None
+
+    @jax.jit
+    def launch():
+        acc, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=SCAN_LEN)
+        return acc
+
+    jax.block_until_ready(launch())  # compile
 
     times = []
-    for step in range(WARMUP + STEPS):
+    for step in range(WARMUP + LAUNCHES):
         start = time.perf_counter()
-        out = jitted(*device_args)
-        jax.block_until_ready(out)
+        jax.block_until_ready(launch())
         elapsed = time.perf_counter() - start
         if step >= WARMUP:
             times.append(elapsed)
 
-    per_batch = float(np.median(times))
+    per_batch = float(np.median(times)) / SCAN_LEN
     images_per_sec = BATCH / per_batch
     print(
         json.dumps(

@@ -191,16 +191,20 @@ def rotated_bounds(w: int, h: int, degrees: float) -> Tuple[int, int]:
     return (max(new_w, 1), max(new_h, 1))
 
 
+def _positive_or_none(value: Optional[int]) -> Optional[int]:
+    """Non-positive target dims are nonsense a URL can carry; treat as
+    unset — shared by build_plan and decode_target_hint so the DCT
+    prescale hint can never diverge from the plan's sanitization."""
+    return value if value and value > 0 else None
+
+
 def decode_target_hint(options: OptionsBag) -> Optional[Tuple[int, int]]:
     """The (w, h) box the decoder may prescale toward (JPEG DCT-domain
     scaling). Accounts for sc_N so an upscaling request never decodes below
     the final target — the decode must stay >= 2x the device resample's
     output for the resample to be quality-determining."""
-    tw = options.int_option("width")
-    th = options.int_option("height")
-    # same sanitization as build_plan: non-positive target dims are unset
-    tw = tw if tw and tw > 0 else None
-    th = th if th and th > 0 else None
+    tw = _positive_or_none(options.int_option("width"))
+    th = _positive_or_none(options.int_option("height"))
     if not (tw or th):
         return None
     w, h = (tw or th), (th or tw)
@@ -252,11 +256,8 @@ def build_plan(
     and background/rotate/unsharp/sharpen/blur come from the forwarded set
     (``checkForwardedOptions``, :303-315).
     """
-    width = options.int_option("width")
-    height = options.int_option("height")
-    # non-positive target dims are nonsense a URL can carry; treat as unset
-    width = width if width and width > 0 else None
-    height = height if height and height > 0 else None
+    width = _positive_or_none(options.int_option("width"))
+    height = _positive_or_none(options.int_option("height"))
     crop = options.truthy("crop")
     pns = options.truthy("preserve-natural-size")
     par = options.truthy("preserve-aspect-ratio")
