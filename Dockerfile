@@ -30,7 +30,7 @@ COPY --from=build /app/flyimg_tpu/codecs/native/libfastcodec.so \
 
 # CPU wheels by default; TPU deployments: pip install 'jax[tpu]' -f
 # https://storage.googleapis.com/jax-releases/libtpu_releases.html
-RUN pip install --no-cache-dir -e ".[models]"
+RUN pip install --no-cache-dir -e ".[models,video]"
 
 EXPOSE 8080
 ENV PYTHONUNBUFFERED=1

@@ -1,16 +1,26 @@
-"""Video frame extraction (gated ingestion backend).
+"""Video frame extraction.
 
-Reference behavior: a video source is swapped for an ffmpeg-extracted frame
-at the ``tm_`` timestamp before the pipeline runs (reference
+Reference behavior: a video source is swapped for an extracted frame at the
+``tm_`` timestamp before the pipeline runs (reference
 src/Core/Entity/Image/InputImage.php:61-68,
 src/Core/Processor/VideoProcessor.php:35-57), frames cached per
-(source, time). This image has no ffmpeg binary, so the backend is gated:
-present -> same behavior; absent -> UnsupportedMediaException (the
-reference's Docker image bundles ffmpeg; we degrade explicitly instead).
+(source, time).
+
+Two backends, best available wins:
+- OpenCV (``cv2.VideoCapture``, in-process libavcodec demux/decode) —
+  no shell-out, seeks by millisecond;
+- the ffmpeg binary, matching the reference's command shape
+  (VideoProcessor.php:35-47).
+Neither present -> UnsupportedMediaException (explicit degradation; the
+reference's Docker image bundles ffmpeg).
+
+A timestamp past the end of the video raises ExecFailedException exactly
+like the reference's empty-output check (VideoProcessor.php:54-57).
 """
 
 from __future__ import annotations
 
+import math
 import shutil
 import subprocess
 
@@ -18,18 +28,67 @@ from flyimg_tpu.exceptions import ExecFailedException, UnsupportedMediaException
 
 FFMPEG = shutil.which("ffmpeg")
 
+try:
+    import cv2  # noqa: F401
 
+    _HAS_CV2 = True
+except ImportError:
+    _HAS_CV2 = False
+
+
+def video_available() -> bool:
+    return _HAS_CV2 or FFMPEG is not None
+
+
+# kept for callers/tests that probe the shell backend specifically
 def ffmpeg_available() -> bool:
     return FFMPEG is not None
 
 
-def extract_frame(video_path: str, time_spec: str, out_path: str) -> str:
-    """Extract one frame at ``time_spec`` ('00:00:01' or seconds) to
-    ``out_path`` (jpg). Mirrors VideoProcessor.php:35-47's command shape."""
-    if FFMPEG is None:
-        raise UnsupportedMediaException(
-            "video sources need ffmpeg, which is not available in this runtime"
-        )
+def _time_spec_ms(time_spec: str) -> float:
+    """'5', '5.25', or 'HH:MM:SS[.frac]' -> milliseconds (reference accepts
+    both forms, docs/url-options.md tm_)."""
+    text = str(time_spec).strip()
+    try:
+        if ":" in text:
+            parts = text.split(":")
+            if len(parts) > 3 or any(p == "" for p in parts):
+                raise ValueError(text)
+            seconds = 0.0
+            for part in parts:
+                seconds = seconds * 60.0 + float(part)
+        else:
+            seconds = float(text)
+    except ValueError:
+        raise ExecFailedException(f"bad time spec: {time_spec!r}") from None
+    if not math.isfinite(seconds) or seconds < 0:
+        raise ExecFailedException(f"bad time spec: {time_spec!r}")
+    return seconds * 1000.0
+
+
+def _extract_frame_cv2(video_path: str, time_spec: str, out_path: str) -> str:
+    import cv2
+
+    ms = _time_spec_ms(time_spec)
+    cap = cv2.VideoCapture(video_path)
+    if not cap.isOpened():
+        raise ExecFailedException(f"cannot open video: {video_path}")
+    try:
+        cap.set(cv2.CAP_PROP_POS_MSEC, ms)
+        ok, frame = cap.read()
+        if not ok or frame is None:
+            # timestamp past end of video (reference VideoProcessor.php:54-57)
+            raise ExecFailedException(
+                f"no frame extracted at {time_spec} (past end of video?)"
+            )
+        if not cv2.imwrite(out_path, frame):
+            raise ExecFailedException(f"cannot write frame to {out_path}")
+    finally:
+        cap.release()
+    return out_path
+
+
+def _extract_frame_ffmpeg(video_path: str, time_spec: str, out_path: str) -> str:
     cmd = [
         FFMPEG, "-y", "-i", video_path, "-ss", str(time_spec),
         "-f", "image2", "-frames:v", "1", out_path,
@@ -42,8 +101,21 @@ def extract_frame(video_path: str, time_spec: str, out_path: str) -> str:
     import os
 
     if not os.path.exists(out_path) or os.path.getsize(out_path) == 0:
-        # timestamp past end of video (reference VideoProcessor.php:54-57)
         raise ExecFailedException(
             f"no frame extracted at {time_spec} (past end of video?)"
         )
     return out_path
+
+
+def extract_frame(video_path: str, time_spec: str, out_path: str) -> str:
+    """Extract one frame at ``time_spec`` ('00:00:01' or seconds) to
+    ``out_path`` (jpg)."""
+    _time_spec_ms(time_spec)  # validate up front: both backends reject the
+    # same malformed specs (bare ffmpeg would clamp e.g. -ss -4 to 0)
+    if _HAS_CV2:
+        return _extract_frame_cv2(video_path, time_spec, out_path)
+    if FFMPEG is not None:
+        return _extract_frame_ffmpeg(video_path, time_spec, out_path)
+    raise UnsupportedMediaException(
+        "video sources need OpenCV or ffmpeg, neither available in this runtime"
+    )

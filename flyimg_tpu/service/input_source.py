@@ -128,7 +128,10 @@ def load_source(
     data_path = cache_path
     if info.is_video:
         time_spec = str(options.get("time") or "00:00:01")
-        frame_path = f"{cache_path}-{time_spec.replace(':', '').replace('.', '')}.jpg"
+        # keep ':' and '.' DISTINGUISHABLE in the cache key (stripping them
+        # would collide tm_1.5 with tm_15) while staying filename-safe
+        safe_time = time_spec.replace(":", "-").replace(".", "_")
+        frame_path = f"{cache_path}-{safe_time}.jpg"
         if not os.path.exists(frame_path) or refresh:
             video_codec.extract_frame(cache_path, time_spec, frame_path)
         data_path = frame_path

@@ -1,0 +1,115 @@
+"""Video ingestion: a video source is swapped for the frame at tm_ before
+the pipeline runs (reference InputImage.php:61-68, VideoProcessor.php),
+via the in-process OpenCV backend. Fixtures are generated with
+cv2.VideoWriter, so no binary blobs live in the repo."""
+
+import io
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from flyimg_tpu.appconfig import AppParameters
+from flyimg_tpu.codecs.video import _time_spec_ms, video_available
+from flyimg_tpu.exceptions import ExecFailedException
+from flyimg_tpu.service.handler import ImageHandler
+from flyimg_tpu.storage import make_storage
+
+cv2 = pytest.importorskip("cv2")
+
+
+@pytest.fixture()
+def env(tmp_path):
+    params = AppParameters(
+        {
+            "upload_dir": str(tmp_path / "uploads"),
+            "tmp_dir": str(tmp_path / "tmp"),
+        }
+    )
+    storage = make_storage(params)
+    return ImageHandler(storage, params), storage, tmp_path
+
+
+def _write_video(path, seconds=3, fps=10, size=(64, 48)):
+    """Each frame's solid gray level encodes its second, so a test can tell
+    WHICH moment was extracted."""
+    w = cv2.VideoWriter(
+        str(path), cv2.VideoWriter_fourcc(*"mp4v"), fps, size
+    )
+    assert w.isOpened()
+    for i in range(seconds * fps):
+        level = 40 + (i // fps) * 60  # second 0 -> 40, 1 -> 100, 2 -> 160
+        w.write(np.full((size[1], size[0], 3), level, np.uint8))
+    w.release()
+    return str(path)
+
+
+def test_time_spec_parsing():
+    assert _time_spec_ms("5") == 5000.0
+    assert _time_spec_ms("2.5") == 2500.0
+    assert _time_spec_ms("00:00:10") == 10000.0
+    assert _time_spec_ms("01:02:03") == 3723000.0
+    with pytest.raises(ExecFailedException):
+        _time_spec_ms("nonsense")
+    with pytest.raises(ExecFailedException):
+        _time_spec_ms("-4")
+
+
+def test_video_available_via_cv2():
+    assert video_available()
+
+
+def test_video_source_yields_frame(env):
+    handler, storage, tmp = env
+    src = _write_video(tmp / "clip.mp4")
+    out = handler.process_image("w_32,h_24,rz_1,o_jpg,tm_1", src)
+    img = Image.open(io.BytesIO(out.content))
+    assert img.format == "JPEG"
+    assert img.size == (32, 24)
+    # frame from second 1 is gray level ~100 (mp4v is lossy; wide net)
+    level = np.asarray(img).mean()
+    assert 80 < level < 120, level
+
+
+def test_video_default_timestamp_is_second_one(env):
+    handler, storage, tmp = env
+    src = _write_video(tmp / "clip2.mp4")
+    out = handler.process_image("w_32,h_24,rz_1,o_jpg", src)
+    level = np.asarray(Image.open(io.BytesIO(out.content))).mean()
+    assert 80 < level < 120, level  # tm default 00:00:01
+
+
+def test_video_timestamps_cached_separately(env):
+    handler, storage, tmp = env
+    src = _write_video(tmp / "clip3.mp4")
+    a = handler.process_image("w_32,h_24,rz_1,o_jpg,tm_0", src)
+    b = handler.process_image("w_32,h_24,rz_1,o_jpg,tm_2", src)
+    assert a.spec.name != b.spec.name
+    la = np.asarray(Image.open(io.BytesIO(a.content))).mean()
+    lb = np.asarray(Image.open(io.BytesIO(b.content))).mean()
+    assert la < 70 < 130 < lb  # second 0 ~40, second 2 ~160
+
+
+def test_timestamp_past_end_raises(env):
+    handler, storage, tmp = env
+    src = _write_video(tmp / "clip4.mp4")
+    with pytest.raises(ExecFailedException):
+        handler.process_image("w_32,tm_00:00:30", src)
+
+
+def test_nan_time_spec_rejected():
+    with pytest.raises(ExecFailedException):
+        _time_spec_ms("nan")
+    with pytest.raises(ExecFailedException):
+        _time_spec_ms("inf")
+
+
+def test_fractional_and_joined_timestamps_cache_separately(env):
+    """tm_1.5 and tm_15 must not collide in the frame cache."""
+    handler, storage, tmp = env
+    src = _write_video(tmp / "clip5.mp4", seconds=16)
+    a = handler.process_image("w_32,h_24,rz_1,o_jpg,tm_1.5", src)
+    b = handler.process_image("w_32,h_24,rz_1,o_jpg,tm_15", src)
+    la = np.asarray(Image.open(io.BytesIO(a.content))).mean()
+    lb = np.asarray(Image.open(io.BytesIO(b.content))).mean()
+    assert la != pytest.approx(lb, abs=5.0)
