@@ -14,7 +14,7 @@ pod; the serving batch path (runtime/batcher.py) stays pure data-parallel.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache
 from typing import Tuple
 
 import jax
@@ -51,13 +51,31 @@ def tiled_transform(
     """Resize [H, W, 3] -> [out_h, out_w, 3] with H sharded over
     ``mesh[axis]``. H and out_h must divide the axis size.
 
+    Programs are cached by (geometry, mesh, method) — serving hot paths
+    (handler._tiled_or_none) re-trace nothing for a repeated geometry.
+    """
+    in_h, in_w = int(image.shape[0]), int(image.shape[1])
+    fn = _build_tiled_program(in_h, in_w, tuple(out_hw), mesh, axis, method)
+    return fn(image.astype(jnp.float32))
+
+
+@lru_cache(maxsize=128)
+def _build_tiled_program(
+    in_h: int,
+    in_w: int,
+    out_hw: Tuple[int, int],
+    mesh: Mesh,
+    axis: str,
+    method: str,
+):
+    """Jitted shard_map program for one tiled-resample geometry.
+
     Per-device work: resample the full width axis locally (replicated W),
     and the height axis from (local tile + halos) with a weight matrix whose
     sample coordinates are offset by the device's global tile position —
     ppermute is the only cross-device communication.
     """
     n = mesh.shape[axis]
-    in_h, in_w = int(image.shape[0]), int(image.shape[1])
     out_h, out_w = out_hw
     if in_h % n or out_h % n:
         raise ValueError(f"H={in_h} and out_h={out_h} must divide mesh axis {n}")
@@ -116,4 +134,4 @@ def tiled_transform(
         in_specs=P(axis, None, None),
         out_specs=P(axis, None, None),
     )
-    return sharded(image.astype(jnp.float32))
+    return jax.jit(sharded)

@@ -73,19 +73,23 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
     # with more than one chip, shard every batch over a data-parallel mesh
     # (SPMD fan-out — the v4-8 serving story; parallel/mesh.py)
     mesh = None
+    sp_mesh = None
     import jax
 
     if len(jax.devices()) > 1:
         from flyimg_tpu.parallel.mesh import make_mesh
 
         mesh = make_mesh()
+        sp_mesh = make_mesh(axis_names=("sp",))
     batcher = BatchController(
         max_batch=int(params.by_key("batch_max_size", 64)),
         deadline_ms=float(params.by_key("batch_deadline_ms", 4.0)),
         metrics=metrics,
         mesh=mesh,
     )
-    handler = ImageHandler(storage, params, batcher=batcher, metrics=metrics)
+    handler = ImageHandler(
+        storage, params, batcher=batcher, metrics=metrics, sp_mesh=sp_mesh
+    )
 
     @web.middleware
     async def request_metrics(request: web.Request, handler):

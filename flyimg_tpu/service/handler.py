@@ -80,6 +80,9 @@ class ProcessedImage:
 
 
 class ImageHandler:
+    # inputs at least this tall consider the spatially-tiled resample
+    TILE_MIN_ROWS = 2048
+
     def __init__(
         self,
         storage: Storage,
@@ -89,12 +92,17 @@ class ImageHandler:
         face_backend=None,
         smartcrop_backend=None,
         metrics=None,
+        sp_mesh=None,
     ) -> None:
         self.storage = storage
         self.params = params
         self.security = SecurityHandler(params)
         self.batcher = batcher  # BatchController; None = direct device calls
         self.metrics = metrics  # runtime.metrics.MetricsRegistry or None
+        # multi-device mesh with an 'sp' axis: very large inputs shard
+        # H-wise with ppermute halo exchange (parallel/tiling.py — the
+        # image-domain analog of context parallelism, SURVEY.md section 5)
+        self.sp_mesh = sp_mesh
         self._face_backend = face_backend
         self._smartcrop_backend = smartcrop_backend
         self._singleflight = _SingleFlight()
@@ -204,6 +212,59 @@ class ImageHandler:
 
     # ------------------------------------------------------------------
 
+    def _tiled_or_none(self, frame: np.ndarray, plan: TransformPlan):
+        """Run the H-sharded halo-exchange resample when it applies:
+        a full-frame resample-only plan, a tall input divisible by the 'sp'
+        axis, and divisible output rows. Anything else -> None (batcher /
+        direct path). This is the 4k-thumbnail-firehose path
+        (BASELINE.md config 4)."""
+        if self.sp_mesh is None or plan.resize_to is None:
+            return None
+        # allowlist, not denylist: the device plan must be EXACTLY a bare
+        # resample (any pixel op — present or added later — fails safe to
+        # the batcher, which runs the full compiled program)
+        bare = TransformPlan(
+            src_size=(0, 0), resize_to=None, extent=None,
+            filter_method=plan.filter_method,
+        )
+        if plan.device_plan() != bare:
+            return None
+        h, w = frame.shape[:2]
+        n = int(self.sp_mesh.shape["sp"])
+        if h < self.TILE_MIN_ROWS or h % n:
+            return None
+        from flyimg_tpu.ops.compose import plan_layout
+
+        # layout geometry checks cover crop windows / extent pads / extract
+        # offsets in one generalizing form (span must be the full frame)
+        layout = plan_layout(plan)
+        out_h, out_w = layout.resample_out
+        if (
+            out_h % n
+            or layout.out_true != (out_h, out_w)
+            or layout.pad_canvas is not None
+            or layout.span_y != (0.0, float(h))
+            or layout.span_x != (0.0, float(w))
+        ):
+            return None
+
+        import jax.numpy as jnp
+
+        from flyimg_tpu.parallel.tiling import tiled_transform
+
+        out = tiled_transform(
+            jnp.asarray(frame), (out_h, out_w), self.sp_mesh,
+            method=plan.filter_method,
+        )
+        if self.metrics is not None:
+            self.metrics.counter(
+                "flyimg_tiled_resamples_total",
+                "Large inputs resampled via sp-axis spatial tiling",
+            ).inc()
+        return np.asarray(
+            jnp.clip(jnp.round(out), 0.0, 255.0).astype(jnp.uint8)
+        )
+
     def _process_new(
         self,
         data: bytes,
@@ -239,7 +300,10 @@ class ImageHandler:
             frame_plan = plan if (fw, fh) == plan.src_size else build_plan(
                 options, fw, fh
             )
-            if self.batcher is not None:
+            tiled = self._tiled_or_none(frame, frame_plan)
+            if tiled is not None:
+                out_frames.append(tiled)
+            elif self.batcher is not None:
                 # concurrent requests sharing a program batch into one
                 # device launch; .result() parks this worker thread while
                 # the group fills (flyimg_tpu/runtime/batcher.py)

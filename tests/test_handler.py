@@ -324,3 +324,62 @@ def test_concurrent_source_fetches_do_not_race(env):
     for t in threads:
         t.join()
     assert not errors
+
+
+def test_tall_input_takes_tiled_path(tmp_path):
+    """A 2048-row resample-only request on an sp mesh runs the H-sharded
+    halo-exchange path and matches the untiled result closely."""
+    from flyimg_tpu.parallel.mesh import make_mesh
+    from flyimg_tpu.runtime.metrics import MetricsRegistry
+
+    params = AppParameters(
+        {"upload_dir": str(tmp_path / "up"), "tmp_dir": str(tmp_path / "tmp")}
+    )
+    storage = make_storage(params)
+    metrics = MetricsRegistry()
+    tiled_handler = ImageHandler(
+        storage, params, metrics=metrics, sp_mesh=make_mesh(axis_names=("sp",))
+    )
+    plain_handler = ImageHandler(
+        make_storage(AppParameters({"upload_dir": str(tmp_path / "up2"),
+                                    "tmp_dir": str(tmp_path / "tmp2")})),
+        params,
+    )
+
+    rng = np.random.default_rng(11)
+    arr = rng.integers(0, 256, (2048, 512, 3), dtype=np.uint8)
+    src = str(tmp_path / "tall.png")
+    Image.fromarray(arr).save(src)
+
+    opts = "w_128,h_512,rz_1,o_png"
+    tiled = tiled_handler.process_image(opts, src)
+    assert metrics.summary().get("flyimg_tiled_resamples_total") == 1.0
+    plain = plain_handler.process_image(opts, src)
+
+    a = np.asarray(Image.open(io.BytesIO(tiled.content)), dtype=np.int16)
+    b = np.asarray(Image.open(io.BytesIO(plain.content)), dtype=np.int16)
+    assert a.shape == b.shape == (512, 128, 3)
+    assert np.abs(a - b).max() <= 2  # halo-exchange vs whole-image resample
+
+
+def test_short_or_cropfill_inputs_skip_tiling(tmp_path):
+    from flyimg_tpu.parallel.mesh import make_mesh
+    from flyimg_tpu.runtime.metrics import MetricsRegistry
+
+    params = AppParameters(
+        {"upload_dir": str(tmp_path / "up"), "tmp_dir": str(tmp_path / "tmp")}
+    )
+    metrics = MetricsRegistry()
+    handler = ImageHandler(
+        make_storage(params), params, metrics=metrics,
+        sp_mesh=make_mesh(axis_names=("sp",)),
+    )
+    src = _write_jpg(tmp_path / "short.jpg", w=640, h=360)
+    handler.process_image("w_128,h_128,rz_1,o_jpg", src)  # too short
+    rng = np.random.default_rng(12)
+    tall = str(tmp_path / "tallcrop.png")
+    Image.fromarray(
+        rng.integers(0, 256, (2048, 256, 3), dtype=np.uint8)
+    ).save(tall)
+    handler.process_image("w_100,h_100,c_1,o_jpg", tall)  # crop window
+    assert "flyimg_tiled_resamples_total" not in metrics.summary()
