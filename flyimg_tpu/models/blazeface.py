@@ -257,3 +257,57 @@ def synthetic_batch(rng: np.random.Generator, batch: int):
         target_boxes[i, pos, 2] = np.log(size / anchors[pos, 2]) / 0.2
         target_boxes[i, pos, 3] = np.log(size / anchors[pos, 3]) / 0.2
     return images, target_probs, target_boxes, mask
+
+
+# ---------------------------------------------------------------------------
+# checkpointing (orbax) + synthetic pre-training
+# ---------------------------------------------------------------------------
+
+
+def save_checkpoint(params, path: str) -> None:
+    """Persist params with orbax (async-capable on real pods; used
+    synchronously here)."""
+    import os
+
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(os.path.abspath(path), params, force=True)
+
+
+def load_checkpoint(path: str):
+    """Restore params saved by save_checkpoint."""
+    import os
+
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(os.path.abspath(path))
+
+
+def train_synthetic(
+    steps: int = 200,
+    batch: int = 16,
+    seed: int = 0,
+    log_every: int = 0,
+):
+    """Train from scratch on the synthetic ellipse-face task — enough for
+    detect_faces to localize high-contrast blobs. Real deployments restore a
+    checkpoint trained on face data instead; the training loop is identical
+    (swap synthetic_batch for a real loader)."""
+    rng = np.random.default_rng(seed)
+    params = init_params(jax.random.PRNGKey(seed))
+    optimizer, train_step = make_train_step()
+    opt_state = optimizer.init(params)
+    step_fn = jax.jit(train_step, donate_argnums=(0, 1))
+    loss = float("nan")  # steps=0: params back unchanged, loss undefined
+    for step in range(steps):
+        images, probs, boxes, mask = synthetic_batch(rng, batch)
+        params, opt_state, loss = step_fn(
+            params, opt_state,
+            jnp.asarray(images), jnp.asarray(probs),
+            jnp.asarray(boxes), jnp.asarray(mask),
+        )
+        if log_every and step % log_every == 0:
+            print(f"step {step}: loss {float(loss):.4f}")
+    return params, float(loss)

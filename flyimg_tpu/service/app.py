@@ -50,16 +50,50 @@ _ERROR_STATUS = {
 }
 
 HOMEPAGE = """<!doctype html>
-<html><head><title>flyimg-tpu</title></head>
-<body style="font-family: sans-serif; max-width: 42em; margin: 3em auto">
+<html><head><title>flyimg-tpu</title>
+<style>
+ body { font-family: system-ui, sans-serif; max-width: 46em; margin: 3em auto;
+        line-height: 1.5; padding: 0 1em; }
+ code { background: #f3f3f3; padding: .1em .3em; border-radius: 3px; }
+ input { font: inherit; padding: .3em; width: 100%; box-sizing: border-box; }
+ label { font-size: .85em; color: #555; }
+ .row { display: flex; gap: .6em; margin: .4em 0; }
+ .row > div { flex: 1; }
+ img.demo { max-width: 100%; border: 1px solid #ddd; margin-top: 1em; }
+ footer { margin-top: 2em; font-size: .85em; color: #777; }
+</style></head>
+<body>
 <h1>flyimg-tpu</h1>
-<p>TPU-native on-the-fly image resizing, cropping and compression.</p>
+<p>TPU-native on-the-fly image resizing, cropping and compression —
+batched JAX/XLA pixel pipeline behind a flyimg-compatible URL API.</p>
 <p>Usage: <code>GET /upload/{options}/{image-url}</code> — e.g.
-<code>/upload/w_300,h_250,c_1/https://example.com/image.jpg</code></p>
-<p>Options: w, h, c, g (gravity), r (rotate), q (quality), o (output:
-auto/input/jpg/png/webp/gif), smc (smart crop), fc/fb (face crop/blur),
-blr/sh/unsh, bg, clsp, mnchr, e+p1x..p2y (extract), ett, rz, pns, par,
-webpl, gf, pg, tm, dnst, rf (refresh) — flyimg-compatible URL grammar.</p>
+<code>/upload/w_300,h_250,c_1/https://example.com/image.jpg</code>.
+Common options: <code>w h c g r q o rz ett bg smc fc fb blr sh unsh clsp
+mnchr e gf pg tm rf</code> (see <code>docs/url-options.md</code>).</p>
+<h2>Try it</h2>
+<div class="row">
+ <div><label>options</label><input id="opts" value="w_300,h_250,c_1"></div>
+</div>
+<div class="row">
+ <div><label>image URL</label><input id="src"
+  value="https://raw.githubusercontent.com/flyimg/flyimg/main/web/Rovinj-Croatia.jpg"></div>
+</div>
+<div class="row"><div>
+ <button onclick="go()">transform</button>
+ <code id="url"></code>
+</div></div>
+<img id="out" class="demo" alt="" style="display:none">
+<script>
+function go() {
+  var u = '/upload/' + document.getElementById('opts').value + '/' +
+          document.getElementById('src').value;
+  document.getElementById('url').textContent = u;
+  var img = document.getElementById('out');
+  img.style.display = 'block';
+  img.src = u;
+}
+</script>
+<footer><a href="/metrics">metrics</a> · <a href="/healthz">health</a></footer>
 </body></html>"""
 
 

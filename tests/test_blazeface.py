@@ -1,0 +1,77 @@
+"""BlazeFace-style detector: orbax checkpoints roundtrip (always), and the
+synthetic-task training loop converges + localizes (opt-in: single-core CPU
+training takes minutes — set FLYIMG_SLOW_TESTS=1 to include it)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from flyimg_tpu.models import blazeface as bf
+
+SLOW = bool(os.environ.get("FLYIMG_SLOW_TESTS"))
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    import jax
+
+    params = bf.init_params(jax.random.PRNGKey(1))
+    path = tmp_path / "ckpt"
+    bf.save_checkpoint(params, str(path))
+    restored = bf.load_checkpoint(str(path))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        params,
+        restored,
+    )
+    # restored params drive detection identically
+    rng = np.random.default_rng(42)
+    images, _, _, _ = bf.synthetic_batch(rng, 1)
+    rgb = ((images[0] + 1.0) * 127.5).clip(0, 255).astype(np.uint8)
+    assert bf.detect_faces(restored, rgb) == bf.detect_faces(params, rgb)
+
+
+def test_one_train_step_reduces_loss():
+    """One optimization step on one batch moves the loss — fast smoke that
+    gradients flow end to end."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(5)
+    params = bf.init_params(jax.random.PRNGKey(5))
+    optimizer, train_step = bf.make_train_step()
+    opt_state = optimizer.init(params)
+    images, probs, boxes, mask = bf.synthetic_batch(rng, 4)
+    args = (jnp.asarray(images), jnp.asarray(probs),
+            jnp.asarray(boxes), jnp.asarray(mask))
+    before = float(bf.loss_fn(params, *args))
+    params2, _, _ = jax.jit(train_step)(params, opt_state, *args)
+    after = float(bf.loss_fn(params2, *args))
+    assert after < before
+
+
+@pytest.mark.skipif(not SLOW, reason="minutes of CPU training; FLYIMG_SLOW_TESTS=1")
+def test_training_converges_and_localizes():
+    params, final_loss = bf.train_synthetic(steps=150, batch=16, seed=3)
+
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(9)
+    images, probs, boxes, mask = bf.synthetic_batch(rng, 16)
+    fresh = bf.init_params(jax.random.PRNGKey(9))
+    args = (jnp.asarray(images), jnp.asarray(probs),
+            jnp.asarray(boxes), jnp.asarray(mask))
+    assert float(bf.loss_fn(params, *args)) < float(bf.loss_fn(fresh, *args)) * 0.5
+
+    rng = np.random.default_rng(77)
+    images, _, _, _ = bf.synthetic_batch(rng, 1)
+    rgb = ((images[0] + 1.0) * 127.5).clip(0, 255).astype(np.uint8)
+    found = bf.detect_faces(params, rgb, score_threshold=0.5)
+    assert found, "trained detector found nothing"
+    blob_rng = np.random.default_rng(77)
+    cx, cy = blob_rng.uniform(0.3, 0.7, 2)
+    x, y, w, h = found[0]
+    bx = (x + w / 2) / rgb.shape[1]
+    by = (y + h / 2) / rgb.shape[0]
+    assert abs(bx - cx) < 0.2 and abs(by - cy) < 0.2, (bx, by, cx, cy)
