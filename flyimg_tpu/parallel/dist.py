@@ -42,13 +42,23 @@ def initialize_multihost(
         int(env_pid) if env_pid else None
     )
     if coordinator_address is None and num_processes is None:
-        # nothing configured: try autodetection only on real TPU platforms
-        if jax.default_backend() != "tpu":
-            return False
+        # Nothing configured: try cloud-metadata autodetection. Must NOT
+        # probe jax.default_backend() first — that initializes the local
+        # backend, after which jax.distributed.initialize() always raises
+        # ("must be called before any JAX computations") and a real pod
+        # would silently come up single-host.
         try:
             jax.distributed.initialize()
             return True
-        except Exception:
+        except Exception as exc:
+            # expected on laptops/CI (no coordinator to autodetect); a real
+            # pod misconfiguration surfaces here too, so leave a trace
+            import logging
+
+            logging.getLogger(__name__).info(
+                "jax.distributed autodetection unavailable (%s); "
+                "continuing single-host", exc,
+            )
             return False
     jax.distributed.initialize(
         coordinator_address=coordinator_address,

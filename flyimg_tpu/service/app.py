@@ -41,6 +41,11 @@ from flyimg_tpu.service.handler import ImageHandler
 from flyimg_tpu.service.response import image_headers
 from flyimg_tpu.storage import make_storage
 
+# typed application-state keys (aiohttp's recommended pattern)
+PARAMS_KEY: web.AppKey[AppParameters] = web.AppKey("params", AppParameters)
+HANDLER_KEY: web.AppKey[ImageHandler] = web.AppKey("handler", ImageHandler)
+METRICS_KEY: web.AppKey = web.AppKey("metrics", object)
+
 _ERROR_STATUS = {
     SecurityException: 403,
     ReadFileException: 404,
@@ -150,9 +155,9 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
     app = web.Application(
         client_max_size=64 * 1024 * 1024, middlewares=[request_metrics]
     )
-    app["params"] = params
-    app["handler"] = handler
-    app["metrics"] = metrics
+    app[PARAMS_KEY] = params
+    app[HANDLER_KEY] = handler
+    app[METRICS_KEY] = metrics
 
     async def _close_batcher(_app):
         batcher.close()
@@ -305,6 +310,11 @@ def main(argv=None) -> int:
         print(SecurityHandler(params).encrypt(args.payload))
         return 0
     if args.cmd == "serve":
+        from flyimg_tpu.parallel.dist import initialize_multihost
+
+        # multi-host pods: wire the DCN coordination plane before any mesh
+        # is built so jax.devices() is the global view (no-op single host)
+        initialize_multihost()
         web.run_app(make_app(params), host=args.host, port=args.port)
         return 0
     parser.print_help()
