@@ -84,3 +84,37 @@ def test_php_openssl_compat():
 
     assert encrypt("w_1/https://a.b/c.png", "sekret", "vector") == php_token
     assert decrypt(php_token, "sekret", "vector") == "w_1/https://a.b/c.png"
+
+
+def test_wire_format_matches_php_openssl_scheme():
+    """Independent oracle: the token must equal base64(openssl-CLI AES-256-CBC)
+    with PHP's key/iv derivation — sha256 hexdigest TEXT as key bytes
+    (openssl truncates to 32), first 16 hex chars as iv. Pins byte-level
+    compatibility with reference-signed URLs (SecurityHandler.php:95-137)."""
+    import base64
+    import hashlib
+    import shutil
+    import subprocess
+
+    if not shutil.which("openssl"):
+        pytest.skip("openssl CLI not available")
+
+    from flyimg_tpu.service.security import encrypt
+
+    security_key, security_iv = "TestKey29", "TestIV042"
+    plain = "w_200,h_180,c_1/https://example.com/a.jpg"
+
+    key_text = hashlib.sha256(security_key.encode()).hexdigest()[:32]
+    iv_text = hashlib.sha256(security_iv.encode()).hexdigest()[:16]
+    proc = subprocess.run(
+        [
+            "openssl", "enc", "-aes-256-cbc", "-base64", "-A",
+            "-K", key_text.encode().hex(),
+            "-iv", iv_text.encode().hex(),
+        ],
+        input=plain.encode(),
+        capture_output=True,
+        check=True,
+    )
+    expected = base64.b64encode(proc.stdout.strip()).decode()
+    assert encrypt(plain, security_key, security_iv) == expected
