@@ -106,11 +106,16 @@ class BatchController:
         deadline_ms: float = 4.0,
         metrics=None,
         mesh=None,
+        lone_flush: bool = True,
     ) -> None:
         from flyimg_tpu.runtime.metrics import MetricsRegistry
 
         self.max_batch = max_batch
         self.deadline_s = deadline_ms / 1000.0
+        # flush a lone request immediately when the device is idle (cuts
+        # sparse-traffic p99 by deadline_ms; disable for deterministic
+        # batch-forming in tests)
+        self.lone_flush = lone_flush
         # optional data-parallel mesh: batches shard over its 'data' axis
         self.mesh = mesh
         self._n_devices = 1
@@ -235,16 +240,29 @@ class BatchController:
             if group is not None:
                 self._execute(group)
 
+    def _group_ready(self, group: _Group, now: float, total_pending: int) -> bool:
+        """The ONE flush-readiness predicate (used by both the wait loop and
+        the pop — drift between two copies would make _run busy-spin):
+        batch full, deadline expired, or the lone-request fast path. The
+        fast path: the executor thread IS the device owner, so evaluating
+        this means the chip is idle — holding a single request for the
+        deadline buys no batching (any later arrival lands in the next
+        batch, which forms while this one executes). Cuts sparse-traffic
+        p99 by deadline_ms (SURVEY.md section 7 hard part 2)."""
+        if len(group.members) >= self.max_batch:
+            return True
+        if now - group.members[0].enqueued_at >= self.deadline_s:
+            return True
+        return self.lone_flush and total_pending == 1
+
     def _ready_group(self) -> bool:
         now = time.monotonic()
-        for group in self._groups.values():
-            if not group.members:
-                continue
-            if len(group.members) >= self.max_batch:
-                return True
-            if now - group.members[0].enqueued_at >= self.deadline_s:
-                return True
-        return False
+        total_pending = sum(len(g.members) for g in self._groups.values())
+        return any(
+            self._group_ready(group, now, total_pending)
+            for group in self._groups.values()
+            if group.members
+        )
 
     def _next_deadline(self) -> Optional[float]:
         now = time.monotonic()
@@ -259,16 +277,16 @@ class BatchController:
 
     def _pop_ready_group(self) -> Optional[_Group]:
         now = time.monotonic()
+        total_pending = sum(len(g.members) for g in self._groups.values())
         best = None
         best_score = None
         for key, group in list(self._groups.items()):
             if not group.members:
                 self._groups.pop(key, None)
                 continue
-            full = len(group.members) >= self.max_batch
-            expired = now - group.members[0].enqueued_at >= self.deadline_s
-            if not (full or expired):
+            if not self._group_ready(group, now, total_pending):
                 continue
+            full = len(group.members) >= self.max_batch
             score = (1 if full else 0, len(group.members))
             if best_score is None or score > best_score:
                 best, best_score = key, score

@@ -14,7 +14,8 @@ from test_ops import make_test_image
 
 @pytest.fixture()
 def controller():
-    ctl = BatchController(max_batch=8, deadline_ms=30.0)
+    # lone_flush off: fixture users pin batch-FORMING behavior
+    ctl = BatchController(max_batch=8, deadline_ms=30.0, lone_flush=False)
     yield ctl
     ctl.close()
 
@@ -42,7 +43,9 @@ def test_batch_matches_single_path(controller):
 def test_mixed_aspect_fit_shares_batch():
     # max_batch == number of submits + a long deadline makes the flush
     # trigger deterministically on batch-full, immune to slow cold starts
-    ctl = BatchController(max_batch=3, deadline_ms=10_000.0)
+    # lone_flush off: this test pins GROUP-SHARING semantics, so the first
+    # submit must wait for the other two instead of flushing solo
+    ctl = BatchController(max_batch=3, deadline_ms=10_000.0, lone_flush=False)
     futures = []
     expected_shapes = []
     # different aspects, same 128-px input bucket (640 x 512)
@@ -97,8 +100,10 @@ def test_mesh_sharded_batch_matches_unsharded():
     from flyimg_tpu.spec.plan import build_plan
 
     mesh = make_mesh()  # 8 virtual CPU devices, axis 'data'
-    plain = BatchController(max_batch=8, deadline_ms=5.0)
-    sharded = BatchController(max_batch=8, deadline_ms=5.0, mesh=mesh)
+    plain = BatchController(max_batch=8, deadline_ms=5.0, lone_flush=False)
+    sharded = BatchController(
+        max_batch=8, deadline_ms=5.0, mesh=mesh, lone_flush=False
+    )
     try:
         rng = np.random.default_rng(5)
         imgs = [
@@ -155,7 +160,11 @@ def test_mesh_nonpow2_device_count_rounds_batch():
     from flyimg_tpu.spec.plan import build_plan
 
     mesh = make_mesh((6,), ("data",), devices=jax.devices()[:6])
-    ctrl = BatchController(max_batch=8, deadline_ms=5.0, mesh=mesh)
+    # lone_flush off so all 5 submits form the one batch whose 5 -> 12
+    # rounding this test exists to pin
+    ctrl = BatchController(
+        max_batch=8, deadline_ms=5.0, mesh=mesh, lone_flush=False
+    )
     try:
         rng = np.random.default_rng(7)
         imgs = [
@@ -166,5 +175,28 @@ def test_mesh_nonpow2_device_count_rounds_batch():
         outs = [f.result(timeout=60) for f in
                 [ctrl.submit(im, pl) for im, pl in zip(imgs, plans)]]
         assert all(o.shape == (32, 32, 3) for o in outs)
+    finally:
+        ctrl.close()
+
+
+def test_lone_request_flushes_before_deadline():
+    """A single pending request on an idle device must not wait out the
+    batching deadline."""
+    import time as _t
+
+    from flyimg_tpu.spec.options import OptionsBag
+    from flyimg_tpu.spec.plan import build_plan
+
+    ctrl = BatchController(max_batch=8, deadline_ms=2000.0)
+    try:
+        rng = np.random.default_rng(8)
+        img = rng.integers(0, 256, size=(64, 64, 3), dtype=np.uint8)
+        plan = build_plan(OptionsBag("w_32,h_32,rz_1"), 64, 64)
+        ctrl.submit(img, plan).result(timeout=60)  # warm the compile
+        t0 = _t.monotonic()
+        out = ctrl.submit(img, plan).result(timeout=60)
+        elapsed = _t.monotonic() - t0
+        assert out.shape == (32, 32, 3)
+        assert elapsed < 1.0, f"lone request waited {elapsed:.2f}s (deadline 2s)"
     finally:
         ctrl.close()
