@@ -41,6 +41,13 @@ from flyimg_tpu.service.handler import ImageHandler
 from flyimg_tpu.service.response import image_headers
 from flyimg_tpu.storage import make_storage
 
+# config-overridable route patterns (reference config/routes.yml); 'home'
+# is fixed at '/'
+DEFAULT_ROUTES = {
+    "upload": "/upload/{options}/{imageSrc:.+}",
+    "path": "/path/{options}/{imageSrc:.+}",
+}
+
 # typed application-state keys (aiohttp's recommended pattern)
 PARAMS_KEY: web.AppKey[AppParameters] = web.AppKey("params", AppParameters)
 HANDLER_KEY: web.AppKey[ImageHandler] = web.AppKey("handler", ImageHandler)
@@ -270,11 +277,28 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
     app.router.add_get("/metrics", metrics_route)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/debug/trace", debug_trace)
-    # imageSrc uses a catch-all pattern so full URLs (with slashes) work as
-    # path parameters — the reference's `imageSrc: .+` route requirement
-    # (config/routes.yml:9,14)
-    app.router.add_get("/upload/{options}/{imageSrc:.+}", upload)
-    app.router.add_get("/path/{options}/{imageSrc:.+}", path)
+    # Route table is config-overridable like the reference's
+    # config/routes.yml (RoutesResolver.php); imageSrc uses a catch-all
+    # pattern so full URLs (with slashes) work as path parameters — the
+    # reference's `imageSrc: .+` route requirement (config/routes.yml:9,14).
+    # Misconfiguration fails HERE, at startup, not per-request.
+    handlers = {"upload": upload, "path": path}
+    routes = dict(DEFAULT_ROUTES)
+    overrides = params.by_key("routes", {}) or {}
+    unknown = set(overrides) - set(handlers)
+    if unknown:
+        raise InvalidArgumentException(
+            f"unknown route names in `routes` config: {sorted(unknown)} "
+            f"(known: {sorted(handlers)})"
+        )
+    routes.update(overrides)
+    for name, pattern in routes.items():
+        if "{options}" not in pattern or "{imageSrc" not in pattern:
+            raise InvalidArgumentException(
+                f"route pattern for {name!r} must contain {{options}} and "
+                f"{{imageSrc:.+}} placeholders, got {pattern!r}"
+            )
+        app.router.add_get(pattern, handlers[name])
     return app
 
 

@@ -178,3 +178,19 @@ def test_metrics_and_healthz(tmp_path, source_png):
     assert "flyimg_stage_seconds" in metrics
     assert health["status"] == "ok"
     assert health["devices"]
+
+
+def test_route_patterns_config_overridable(tmp_path, source_png):
+    """The route table is config-driven like the reference's routes.yml."""
+    status, _, _ = _request(
+        tmp_path,
+        f"/img/w_30,o_png/{source_png}",
+        params_extra={"routes": {"upload": "/img/{options}/{imageSrc:.+}"}},
+    )
+    assert status == 200
+    status, _, _ = _request(
+        tmp_path,
+        f"/upload/w_30,o_png/{source_png}",
+        params_extra={"routes": {"upload": "/img/{options}/{imageSrc:.+}"}},
+    )
+    assert status == 404
