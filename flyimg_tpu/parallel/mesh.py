@@ -2,11 +2,41 @@
 
 from __future__ import annotations
 
+import os
+import re
 from typing import Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def force_cpu_platform(n_devices: int = 1) -> None:
+    """Force the CPU platform with an ``n_devices``-wide virtual host mesh.
+
+    The one order-sensitive recipe for this environment, shared by the test
+    conftest, the driver's ``dryrun_multichip`` contract, and the bench's
+    TPU-outage fallback: arm XLA_FLAGS (parsed once process-wide at first
+    client init), set JAX_PLATFORMS, override via jax.config too — this
+    environment's sitecustomize force-selects the axon/TPU platform at
+    interpreter start, overriding the env var alone — and drop any backend
+    that already initialized.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from jax.extend.backend import clear_backends
+
+    clear_backends()
+    jax.config.update("jax_platforms", "cpu")
+    # XLA_FLAGS is parsed C++-side only at the process's FIRST client init;
+    # if any client already existed (this env's sitecustomize can create
+    # one at interpreter start) the flag is a no-op, so set the documented
+    # Python-level device count too (jax>=0.4.34).
+    jax.config.update("jax_num_cpu_devices", n_devices)
 
 
 def make_mesh(

@@ -1,27 +1,15 @@
 """Test harness config: force the CPU backend with a virtual 8-device mesh
 so sharding tests run anywhere (the standard fake-mesh trick; see SURVEY.md
-section 4).
-
-Note: this environment's sitecustomize force-selects the axon/TPU platform
-via jax.config at interpreter start, overriding the JAX_PLATFORMS env var —
-so the override here must go through jax.config.update AFTER importing jax,
-before any backend initializes.
+section 4). The order-sensitive recipe lives in one place —
+``flyimg_tpu.parallel.mesh.force_cpu_platform`` — shared with the driver
+contract (``__graft_entry__.dryrun_multichip``) and the bench fallback.
 """
 
-import os
+from flyimg_tpu.parallel.mesh import force_cpu_platform
 
-import re
-
-flags = os.environ.get("XLA_FLAGS", "")
-flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)
-os.environ["XLA_FLAGS"] = (
-    flags + " --xla_force_host_platform_device_count=8"
-).strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+force_cpu_platform(8)
 
 import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
 
 assert jax.devices()[0].platform == "cpu", jax.devices()
 assert len(jax.devices()) == 8, jax.devices()
