@@ -22,6 +22,7 @@ FaceDetectProcessor.php:22-42).
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from typing import List, Tuple
 
 import jax
@@ -78,14 +79,12 @@ def _morph_clean(mask: jnp.ndarray) -> jnp.ndarray:
     return m > 0.5
 
 
-def detect_faces(rgb: np.ndarray, threshold: float = 0.35) -> List[Box]:
-    """Detect face-like skin regions; boxes sorted left-to-right then
-    top-to-bottom (matching facedetect's reading order output, so ``fcp``
+def _boxes_from_mask(mask: np.ndarray) -> List[Box]:
+    """Connected components -> face boxes, sorted left-to-right then
+    top-to-bottom (matching facedetect's reading-order output, so ``fcp``
     indices behave comparably)."""
     from scipy import ndimage
 
-    prob = np.asarray(_skin_probability(jnp.asarray(rgb)))
-    mask = np.asarray(_morph_clean(jnp.asarray(prob > threshold)))
     labels, count = ndimage.label(mask)
     if count == 0:
         return []
@@ -106,6 +105,113 @@ def detect_faces(rgb: np.ndarray, threshold: float = 0.35) -> List[Box]:
         boxes.append((sl[1].start, sl[0].start, bw, bh))
     boxes.sort(key=lambda b: (b[1], b[0]))
     return boxes[:MAX_FACES]
+
+
+def detect_faces(rgb: np.ndarray, threshold: float = 0.35) -> List[Box]:
+    """Detect face-like skin regions in one image. The batched serving
+    path is ``prepare_face_work`` + ``detect_faces_batched``."""
+    prob = np.asarray(_skin_probability(jnp.asarray(rgb)))
+    mask = np.asarray(_morph_clean(jnp.asarray(prob > threshold)))
+    return _boxes_from_mask(mask)
+
+
+# ---------------------------------------------------------------------------
+# batched serving path: detection for many images in one device launch per
+# shape bucket (per-image jits would recompile for every post-resize size)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class FaceWork:
+    image: np.ndarray                # [h, w, 3] uint8
+    threshold: float
+    bucket: Tuple[int, int]          # padded (h, w) compile bucket
+
+
+def prepare_face_work(rgb: np.ndarray, threshold: float = 0.35) -> FaceWork:
+    from flyimg_tpu.ops.compose import _bucket_dim
+
+    h, w = rgb.shape[:2]
+    return FaceWork(
+        image=np.ascontiguousarray(rgb),
+        threshold=threshold,
+        bucket=(_bucket_dim(h, 32), _bucket_dim(w, 32)),
+    )
+
+
+@jax.jit
+def _batched_face_masks(
+    images: jnp.ndarray, in_true: jnp.ndarray, thresholds: jnp.ndarray
+) -> jnp.ndarray:
+    """[B, bh, bw, 3] uint8 + valid dims + thresholds -> [B, bh, bw] bool
+    cleaned masks. Morphology windows are clipped to each member's valid
+    region (padding forced to the pooling identity), which is exactly the
+    'SAME' border behavior of the unbatched path on an unpadded image."""
+
+    def pool_max(m, k=5):
+        return jax.lax.reduce_window(
+            m, -jnp.inf, jax.lax.max, (k, k), (1, 1), "SAME"
+        )
+
+    def one(img, true_hw, threshold):
+        prob = _skin_probability(img)
+        h, w = prob.shape
+        valid = (jnp.arange(h)[:, None] < true_hw[0]) & (
+            jnp.arange(w)[None, :] < true_hw[1]
+        )
+        m = jnp.where(valid, (prob > threshold).astype(jnp.float32), 0.0)
+
+        def erode(x):
+            return -pool_max(jnp.where(valid, -x, -jnp.inf))
+
+        def dilate(x):
+            return pool_max(jnp.where(valid, x, -jnp.inf))
+
+        m = dilate(dilate(erode(m)))  # open (erode+dilate), then dilate
+        m = erode(m)                  # close complete
+        return (m > 0.5) & valid
+
+    return jax.vmap(one)(images, in_true, thresholds)
+
+
+def detect_faces_batched(items: List[FaceWork]) -> List[List[Box]]:
+    """Face boxes for many images: one jitted mask program per shape
+    bucket, host component extraction per member. Equivalent to per-image
+    detect_faces (pinned by tests/test_handler.py)."""
+    from collections import defaultdict
+
+    from flyimg_tpu.ops.compose import bucket_batch
+
+    results: List[List[Box]] = [None] * len(items)  # type: ignore
+    by_bucket = defaultdict(list)
+    for i, item in enumerate(items):
+        by_bucket[item.bucket].append(i)
+    for bucket, idxs in by_bucket.items():
+        bh, bw = bucket
+        n = len(idxs)
+        nb = bucket_batch(n)  # power-of-two occupancy ladder
+        images = np.zeros((nb, bh, bw, 3), np.uint8)
+        in_true = np.zeros((nb, 2), np.float32)
+        thresholds = np.zeros((nb,), np.float32)
+        for j, i in enumerate(idxs):
+            h, w = items[i].image.shape[:2]
+            images[j, :h, :w] = items[i].image
+            in_true[j] = (h, w)
+            thresholds[j] = items[i].threshold
+        for j in range(n, nb):
+            images[j] = images[n - 1]
+            in_true[j] = in_true[n - 1]
+            thresholds[j] = thresholds[n - 1]
+        masks = np.asarray(
+            _batched_face_masks(
+                jnp.asarray(images), jnp.asarray(in_true),
+                jnp.asarray(thresholds),
+            )
+        )
+        for j, i in enumerate(idxs):
+            h, w = items[i].image.shape[:2]
+            results[i] = _boxes_from_mask(masks[j, :h, :w])
+    return results
 
 
 def blur_faces(rgb: np.ndarray, boxes: List[Box]) -> np.ndarray:

@@ -28,8 +28,10 @@ to ~111px, scales {1.0, 0.9}, stride 8, and the quirky output geometry
 from __future__ import annotations
 
 import math
-from functools import lru_cache
-from typing import Dict, Tuple
+from collections import defaultdict
+from dataclasses import dataclass
+from functools import lru_cache, partial
+from typing import Dict, List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -92,61 +94,11 @@ def analyse_features(rgb: jnp.ndarray) -> jnp.ndarray:
     """[h, w, 3] uint8 -> [h, w, 3] float32 feature maps in [0, 255]:
     channel 0 = skin, 1 = edge (detail), 2 = saturation — the reference's
     R/G/B analyse image (smartcrop.py:97-101), quantized like its uint8
-    round-trip."""
-    rgbf = rgb.astype(jnp.float32)
-    r, g, b = rgbf[..., 0], rgbf[..., 1], rgbf[..., 2]
-    # PIL convert('L', (0.2126, 0.7152, 0.0722, 0)) truncates to uint8
-    cie = jnp.floor(0.2126 * r + 0.7152 * g + 0.0722 * b)
-
-    # edge: 3x3 Laplacian, offset 1, clamped (PIL Kernel scale=1 offset=1,
-    # smartcrop.py:231-232); PIL convolves the L (uint8) image
-    lap = (
-        4.0 * cie
-        - jnp.roll(cie, 1, 0) - jnp.roll(cie, -1, 0)
-        - jnp.roll(cie, 1, 1) - jnp.roll(cie, -1, 1)
-    )
-    # PIL ImageFilter leaves the 1px border unfiltered (copies source)
-    h, w = cie.shape
-    yy = jnp.arange(h)[:, None]
-    xx = jnp.arange(w)[None, :]
-    border = (yy == 0) | (yy == h - 1) | (xx == 0) | (xx == w - 1)
-    edge = jnp.where(border, cie, jnp.clip(lap + 1.0, 0.0, 255.0))
-    edge = jnp.floor(edge)
-
-    # skin (smartcrop.py:250-274)
-    mag = jnp.sqrt(r * r + g * g + b * b)
-    safe_mag = jnp.where(mag < 1e-6, 1.0, mag)
-    rd = jnp.where(mag < 1e-6, -SKIN_COLOR[0], r / safe_mag - SKIN_COLOR[0])
-    gd = jnp.where(mag < 1e-6, -SKIN_COLOR[1], g / safe_mag - SKIN_COLOR[1])
-    bd = jnp.where(mag < 1e-6, -SKIN_COLOR[2], b / safe_mag - SKIN_COLOR[2])
-    skin = 1.0 - jnp.sqrt(rd * rd + gd * gd + bd * bd)
-    skin_mask = (
-        (skin > SKIN_THRESHOLD)
-        & (cie >= SKIN_BRIGHTNESS_MIN * 255.0)
-        & (cie <= SKIN_BRIGHTNESS_MAX * 255.0)
-    )
-    skin_data = (skin - SKIN_THRESHOLD) * (255.0 / (1.0 - SKIN_THRESHOLD))
-    skin_out = jnp.floor(jnp.clip(jnp.where(skin_mask, skin_data, 0.0), 0.0, 255.0))
-
-    # saturation (smartcrop.py:16-27, 234-248)
-    maximum = jnp.maximum(jnp.maximum(r, g), b)
-    minimum = jnp.minimum(jnp.minimum(r, g), b)
-    eq = maximum == minimum
-    ssum = (maximum + minimum) / 255.0
-    d_ = (maximum - minimum) / 255.0
-    d_ = jnp.where(eq, 0.0, d_)
-    ssum = jnp.where(eq, 1.0, ssum)
-    ssum = jnp.where(ssum > 1.0, 2.0 - d_, ssum)
-    sat = d_ / ssum
-    sat_mask = (
-        (sat > SATURATION_THRESHOLD)
-        & (cie >= SATURATION_BRIGHTNESS_MIN * 255.0)
-        & (cie <= SATURATION_BRIGHTNESS_MAX * 255.0)
-    )
-    sat_data = (sat - SATURATION_THRESHOLD) * (255.0 / (1.0 - SATURATION_THRESHOLD))
-    sat_out = jnp.floor(jnp.clip(jnp.where(sat_mask, sat_data, 0.0), 0.0, 255.0))
-
-    return jnp.stack([skin_out, edge, sat_out], axis=-1)
+    round-trip. One implementation serves both the exact-shape and the
+    bucket-padded (batched serving) paths: here the valid region IS the
+    array."""
+    h, w = rgb.shape[:2]
+    return _analyse_features_valid(rgb, jnp.array([h, w], jnp.float32))
 
 
 # ---------------------------------------------------------------------------
@@ -245,11 +197,14 @@ def find_best_crop(
     else:
         prescale_size = 1.0
 
-    # the weighted scoring field, computed ONCE and reused across scales:
-    # fused Pallas stencil kernel where Mosaic compiles it (TPU), XLA
-    # feature-map path elsewhere (interpret-mode pallas is test-only)
+    # the weighted scoring field, computed ONCE and reused across scales.
+    # The XLA feature-map path is canonical: measured on-chip it matches
+    # the fused Pallas stencil kernel's speed (XLA fuses this elementwise+
+    # small-stencil chain itself), and it is bit-identical to the batched
+    # serving path, where the Pallas field differs by up to ~7e-3 (enough
+    # to flip an argmax near-tie). Pallas stays as an explicit opt-in.
     if use_pallas is None:
-        use_pallas = jax.default_backend() == "tpu"
+        use_pallas = False
     if use_pallas:
         from flyimg_tpu.ops.pallas_kernels import saliency_field
 
@@ -307,18 +262,13 @@ def _host_thumbnail(rgb: np.ndarray, w: int, h: int) -> np.ndarray:
     return np.asarray(Image.fromarray(rgb).resize((max(w, 1), max(h, 1)), Image.LANCZOS))
 
 
-def smart_crop_image(rgb: np.ndarray) -> np.ndarray:
-    """The post-pass the handler calls: crop `rgb` like the reference's
-    `smartcrop.py | convert -crop` pipeline (SmartCropProcessor.php:21-36).
-
-    The reference prints "WxH+X+Y" with W = x + width, H = y + height
-    (smartcrop.py:372-377 — the bottom-right corner, not the size) and IM's
-    -crop clamps the oversized region to the image bounds; reproduce both
-    quirks exactly.
-    """
+def apply_crop(rgb: np.ndarray, crop: Dict[str, int]) -> np.ndarray:
+    """Apply a found crop the way the reference pipeline does
+    (SmartCropProcessor.php:21-36): the reference prints "WxH+X+Y" with
+    W = x + width, H = y + height (smartcrop.py:372-377 — the bottom-right
+    corner, not the size) and IM's -crop clamps the oversized region to the
+    image bounds; reproduce both quirks exactly."""
     img_h, img_w = rgb.shape[:2]
-    # reference main(): width=100, height=int(h_opt / w_opt * 100) = 100
-    crop = find_best_crop(rgb, 100, 100)
     geom_w = crop["width"] + crop["x"]
     geom_h = crop["height"] + crop["y"]
     x0 = min(crop["x"], img_w)
@@ -326,3 +276,325 @@ def smart_crop_image(rgb: np.ndarray) -> np.ndarray:
     x1 = min(x0 + geom_w, img_w)
     y1 = min(y0 + geom_h, img_h)
     return rgb[y0:y1, x0:x1]
+
+
+def smart_crop_image(rgb: np.ndarray) -> np.ndarray:
+    """The single-image post-pass: crop `rgb` like the reference's
+    `smartcrop.py | convert -crop` pipeline. The batched serving path is
+    ``prepare_work`` + ``find_best_crops_batched`` + ``apply_crop``."""
+    # reference main(): width=100, height=int(h_opt / w_opt * 100) = 100
+    return apply_crop(rgb, find_best_crop(rgb, 100, 100))
+
+
+# ---------------------------------------------------------------------------
+# batched serving path: many images -> crops in ONE device launch per
+# shape bucket (the program bench.py measures is batched; serving must be
+# too, or every distinct post-resize shape recompiles analyse_features)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class WorkItem:
+    """Everything the batched scorer needs about one image: the prescaled
+    work pixels plus the crop-geometry bookkeeping of find_best_crop()."""
+
+    work: np.ndarray                 # [wh, ww, 3] uint8 prescaled image
+    prescale_size: float
+    crop_w: float                    # base crop dims in work coords
+    crop_h: float
+    scales: Tuple[float, ...]        # candidate scale multipliers
+    step: int
+    img_w: int
+    img_h: int
+    bucket: Tuple[int, int]          # padded (h, w) compile bucket
+
+
+def prepare_work(
+    rgb: np.ndarray,
+    target_w: int = 100,
+    target_h: int = 100,
+    *,
+    min_scale: float = 0.9,
+    max_scale: float = 1.0,
+    scale_step: float = 0.1,
+    step: int = 8,
+) -> WorkItem:
+    """The host-side prescale bookkeeping of find_best_crop(), split out so
+    the device part can batch across requests."""
+    from flyimg_tpu.ops.compose import _bucket_dim
+
+    img_h, img_w = rgb.shape[:2]
+    scale = min(img_w / target_w, img_h / target_h)
+    crop_w = int(math.floor(target_w * scale))
+    crop_h = int(math.floor(target_h * scale))
+    mscale = min(max_scale, max(1.0 / scale, min_scale))
+
+    prescale_size = 1.0 / scale / mscale
+    work = rgb
+    if prescale_size < 1.0:
+        work = _host_thumbnail(
+            rgb, int(img_w * prescale_size), int(img_h * prescale_size)
+        )
+        crop_w = int(math.floor(crop_w * prescale_size))
+        crop_h = int(math.floor(crop_h * prescale_size))
+    else:
+        prescale_size = 1.0
+
+    scales = tuple(
+        pct / 100.0
+        for pct in range(
+            int(max_scale * 100),
+            int((mscale - scale_step) * 100),
+            -int(scale_step * 100),
+        )
+    )
+    wh, ww = work.shape[:2]
+    bucket = (_bucket_dim(wh, 32), _bucket_dim(ww, 32))
+    return WorkItem(
+        work=np.ascontiguousarray(work),
+        prescale_size=prescale_size,
+        crop_w=float(crop_w),
+        crop_h=float(crop_h),
+        scales=scales,
+        step=step,
+        img_w=img_w,
+        img_h=img_h,
+        bucket=bucket,
+    )
+
+
+def _analyse_features_valid(rgb: jnp.ndarray, true_hw: jnp.ndarray) -> jnp.ndarray:
+    """The one feature-map implementation, on a possibly bucket-padded
+    image with a dynamic valid region: pixels at (y, x) < true_hw get
+    exactly the reference maps — the PIL unfiltered border lands on the
+    VALID edge, not the padded array edge — and the padded remainder is
+    garbage the caller masks off."""
+    rgbf = rgb.astype(jnp.float32)
+    r, g, b = rgbf[..., 0], rgbf[..., 1], rgbf[..., 2]
+    # PIL convert('L', (0.2126, 0.7152, 0.0722, 0)) truncates to uint8
+    cie = jnp.floor(0.2126 * r + 0.7152 * g + 0.0722 * b)
+
+    # edge: 3x3 Laplacian, offset 1, clamped (PIL Kernel scale=1 offset=1,
+    # smartcrop.py:231-232); PIL convolves the L (uint8) image and leaves
+    # the 1px (valid-region) border unfiltered
+    lap = (
+        4.0 * cie
+        - jnp.roll(cie, 1, 0) - jnp.roll(cie, -1, 0)
+        - jnp.roll(cie, 1, 1) - jnp.roll(cie, -1, 1)
+    )
+    h, w = cie.shape
+    th, tw = true_hw[0], true_hw[1]
+    yy = jnp.arange(h)[:, None]
+    xx = jnp.arange(w)[None, :]
+    border = (yy == 0) | (yy == th - 1) | (xx == 0) | (xx == tw - 1)
+    edge = jnp.where(border, cie, jnp.clip(lap + 1.0, 0.0, 255.0))
+    edge = jnp.floor(edge)
+
+    # skin (smartcrop.py:250-274)
+    mag = jnp.sqrt(r * r + g * g + b * b)
+    safe_mag = jnp.where(mag < 1e-6, 1.0, mag)
+    rd = jnp.where(mag < 1e-6, -SKIN_COLOR[0], r / safe_mag - SKIN_COLOR[0])
+    gd = jnp.where(mag < 1e-6, -SKIN_COLOR[1], g / safe_mag - SKIN_COLOR[1])
+    bd = jnp.where(mag < 1e-6, -SKIN_COLOR[2], b / safe_mag - SKIN_COLOR[2])
+    skin = 1.0 - jnp.sqrt(rd * rd + gd * gd + bd * bd)
+    skin_mask = (
+        (skin > SKIN_THRESHOLD)
+        & (cie >= SKIN_BRIGHTNESS_MIN * 255.0)
+        & (cie <= SKIN_BRIGHTNESS_MAX * 255.0)
+    )
+    skin_data = (skin - SKIN_THRESHOLD) * (255.0 / (1.0 - SKIN_THRESHOLD))
+    skin_out = jnp.floor(jnp.clip(jnp.where(skin_mask, skin_data, 0.0), 0.0, 255.0))
+
+    # saturation (smartcrop.py:16-27, 234-248)
+    maximum = jnp.maximum(jnp.maximum(r, g), b)
+    minimum = jnp.minimum(jnp.minimum(r, g), b)
+    eq = maximum == minimum
+    ssum = (maximum + minimum) / 255.0
+    d_ = (maximum - minimum) / 255.0
+    d_ = jnp.where(eq, 0.0, d_)
+    ssum = jnp.where(eq, 1.0, ssum)
+    ssum = jnp.where(ssum > 1.0, 2.0 - d_, ssum)
+    sat = d_ / ssum
+    sat_mask = (
+        (sat > SATURATION_THRESHOLD)
+        & (cie >= SATURATION_BRIGHTNESS_MIN * 255.0)
+        & (cie <= SATURATION_BRIGHTNESS_MAX * 255.0)
+    )
+    sat_data = (sat - SATURATION_THRESHOLD) * (255.0 / (1.0 - SATURATION_THRESHOLD))
+    sat_out = jnp.floor(jnp.clip(jnp.where(sat_mask, sat_data, 0.0), 0.0, 255.0))
+
+    return jnp.stack([skin_out, edge, sat_out], axis=-1)
+
+
+@jax.jit
+def _batched_weighted(images: jnp.ndarray, in_true: jnp.ndarray) -> jnp.ndarray:
+    """[B, bh, bw, 3] uint8 + [B, 2] valid dims -> [B, bh, bw] float32
+    weighted scoring fields, zero outside each member's valid region (so
+    box sums / totals over the padded array are exact)."""
+
+    def one(img, true_hw):
+        wf = weighted_field(_analyse_features_valid(img, true_hw))
+        h, w = img.shape[:2]
+        valid = (jnp.arange(h)[:, None] < true_hw[0]) & (
+            jnp.arange(w)[None, :] < true_hw[1]
+        )
+        return jnp.where(valid, wf, 0.0)
+
+    return jax.vmap(one)(images, in_true)
+
+
+@partial(jax.jit, static_argnames=("stride",))
+def _batched_scores(weighted: jnp.ndarray, kernels: jnp.ndarray, stride: int):
+    """[B, fh, fw] fields x [B, khm, kwm, 1, C] per-member kernel stacks ->
+    ([B, ny, nx, C] candidate grids, [B] field totals). Channel c < S is the
+    scale-c importance kernel, channel S+c its box-sum ones mask; both are
+    zero-padded to the (khm, kwm) bucket, which contributes exactly nothing
+    to a VALID conv over a field that is itself zero-padded."""
+
+    def one(field, ker):
+        inp = field[None, :, :, None]
+        dn = jax.lax.conv_dimension_numbers(
+            inp.shape, ker.shape, ("NHWC", "HWIO", "NHWC")
+        )
+        out = jax.lax.conv_general_dilated(
+            inp, ker, (stride, stride), "VALID", dimension_numbers=dn,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        return out[0]
+
+    grids = jax.vmap(one)(weighted, kernels)
+    totals = jnp.sum(weighted, axis=(1, 2))
+    return grids, totals
+
+
+def _member_scale_geometry(item: WorkItem, s: float):
+    """(cw, ch, max_x, max_y) for one candidate scale, or None when the
+    scale is skipped (find_best_crop's `continue` guards)."""
+    cw = item.crop_w * s
+    ch = item.crop_h * s
+    if cw < 1.0 or ch < 1.0:
+        return None
+    wh, ww = item.work.shape[:2]
+    max_x = int((ww - cw) // item.step) * item.step
+    max_y = int((wh - ch) // item.step) * item.step
+    if max_x < 0 or max_y < 0:
+        return None
+    return cw, ch, max_x, max_y
+
+
+def find_best_crops_batched(items: Sequence[WorkItem]) -> List[Dict[str, int]]:
+    """Crops for many images in one batched device launch per shape bucket.
+    Exactly equivalent to per-image find_best_crop (pinned by
+    tests/test_smartcrop.py): padding is zeros that cancel out of every conv
+    and sum, and the per-member float crop dims ride in the kernels."""
+    results: List[Dict[str, int]] = [None] * len(items)  # type: ignore
+    by_bucket = defaultdict(list)
+    for i, item in enumerate(items):
+        by_bucket[(item.bucket, item.step)].append(i)
+    for (bucket, step), idxs in by_bucket.items():
+        crops = _run_bucket([items[i] for i in idxs], bucket, step)
+        for i, crop in zip(idxs, crops):
+            results[i] = crop
+    return results
+
+
+def _run_bucket(
+    items: Sequence[WorkItem], bucket: Tuple[int, int], step: int
+) -> List[Dict[str, int]]:
+    from flyimg_tpu.ops.compose import _bucket_dim, bucket_batch
+
+    n = len(items)
+    # batch axis rides the power-of-two ladder (pad slots repeat the last
+    # member) so occupancy 3 vs 5 vs 7 doesn't each compile a fresh program
+    nb = bucket_batch(n)
+    bh, bw = bucket
+    images = np.zeros((nb, bh, bw, 3), np.uint8)
+    in_true = np.zeros((nb, 2), np.float32)
+    for i, item in enumerate(items):
+        wh, ww = item.work.shape[:2]
+        images[i, :wh, :ww] = item.work
+        in_true[i] = (wh, ww)
+    for i in range(n, nb):
+        images[i] = images[n - 1]
+        in_true[i] = in_true[n - 1]
+    weighted = _batched_weighted(jnp.asarray(images), jnp.asarray(in_true))
+
+    n_scales = max(len(item.scales) for item in items)
+    kh_max = kw_max = 1
+    y_max = x_max = 0
+    geoms = []
+    for item in items:
+        per_scale = []
+        for s in item.scales:
+            geom = _member_scale_geometry(item, s)
+            per_scale.append(geom)
+            if geom is None:
+                continue
+            cw, ch, mx, my = geom
+            kh_max = max(kh_max, int(math.ceil(ch)))
+            kw_max = max(kw_max, int(math.ceil(cw)))
+            y_max = max(y_max, my)
+            x_max = max(x_max, mx)
+        geoms.append(per_scale)
+    khm = _bucket_dim(kh_max, 16)
+    kwm = _bucket_dim(kw_max, 16)
+    # the conv's VALID grid must reach every candidate position: grow the
+    # (zero-padded, score-neutral) field so (fh - khm)//step covers y_max
+    fh = max(bh, _bucket_dim(y_max + khm, 32))
+    fw = max(bw, _bucket_dim(x_max + kwm, 32))
+    if (fh, fw) != (bh, bw):
+        weighted = jnp.pad(weighted, ((0, 0), (0, fh - bh), (0, fw - bw)))
+
+    kernels = np.zeros((nb, khm, kwm, 1, 2 * n_scales), np.float32)
+    for i, item in enumerate(items):
+        for si, geom in enumerate(geoms[i]):
+            if geom is None:
+                continue
+            cw, ch, _, _ = geom
+            ker = importance_kernel(cw, ch)
+            kh, kw = ker.shape
+            kernels[i, :kh, :kw, 0, si] = ker
+            kernels[i, :kh, :kw, 0, n_scales + si] = 1.0
+    for i in range(n, nb):
+        kernels[i] = kernels[n - 1]
+
+    grids, totals = _batched_scores(weighted, jnp.asarray(kernels), stride=step)
+    grids = np.asarray(grids)
+    totals = np.asarray(totals)
+
+    out: List[Dict[str, int]] = []
+    for i, item in enumerate(items):
+        best = None
+        for si, geom in enumerate(geoms[i]):
+            if geom is None:
+                continue
+            cw, ch, mx, my = geom
+            ny = my // step + 1
+            nx = mx // step + 1
+            inside = grids[i, :ny, :nx, si]
+            boxsum = grids[i, :ny, :nx, n_scales + si]
+            scores = (
+                inside + OUTSIDE_IMPORTANCE * (totals[i] - boxsum)
+            ) / (cw * ch)
+            if scores.size == 0:
+                continue
+            idx = np.unravel_index(np.argmax(scores), scores.shape)
+            top = float(scores[idx])
+            if best is None or top > best[0]:
+                best = (top, idx[1] * step, idx[0] * step, cw, ch)
+        if best is None:
+            out.append(
+                {"x": 0, "y": 0, "width": item.img_w, "height": item.img_h}
+            )
+            continue
+        _, x, y, cw, ch = best
+        ps = item.prescale_size
+        out.append(
+            {
+                "x": int(math.floor(x / ps)),
+                "y": int(math.floor(y / ps)),
+                "width": int(math.floor(cw / ps)),
+                "height": int(math.floor(ch / ps)),
+            }
+        )
+    return out

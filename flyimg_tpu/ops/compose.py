@@ -156,6 +156,13 @@ def _bucket_dim(size: int, step: int = 128) -> int:
     return max(((size + step - 1) // step) * step, step)
 
 
+def bucket_batch(n: int) -> int:
+    """Round a batch occupancy up the power-of-two ladder so XLA compiles a
+    handful of batch shapes per program, not one per occupancy. Shared by
+    the transform batcher and the aux (scoring/detection) programs."""
+    return 1 << max(n - 1, 0).bit_length()
+
+
 def run_plan(image: np.ndarray, plan: TransformPlan) -> np.ndarray:
     """Execute a plan on one host image [h, w, 3] uint8 -> uint8 output.
 

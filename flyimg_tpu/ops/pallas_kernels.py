@@ -20,10 +20,12 @@ pipelines the extra streams, no manual DMA needed. PIL's convolution border
 rule (border pixels copy the source, smartcrop feature behavior) is applied
 with global row/col masks.
 
-Numerics match models/smartcrop.analyse_features bit-for-bit-ish: every
-feature is floored to the uint8 grid exactly like the reference's PIL
-round-trip, so `find_best_crop` picks identical windows whichever
-implementation runs.
+Numerics: in interpret mode the kernel matches the XLA feature path to
+1e-5 (test-pinned); compiled via Mosaic on real TPU the weighted field can
+differ by up to ~7e-3 (different float contraction), enough to flip an
+argmax near-tie. Serving and bench therefore use the XLA path as canonical
+(measured on-chip at the same speed — XLA fuses this chain well), and this
+kernel is an explicit opt-in (``find_best_crop(..., use_pallas=True)``).
 """
 
 from __future__ import annotations

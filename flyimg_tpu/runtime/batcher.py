@@ -77,8 +77,8 @@ def build_batched_program(
 
 @dataclass
 class _Pending:
-    image: np.ndarray               # [h, w, 3] uint8
-    plan: TransformPlan
+    image: np.ndarray               # [h, w, 3] uint8 (or aux payload)
+    plan: Optional[TransformPlan]
     future: Future
     enqueued_at: float
     out_true: Tuple[int, int]       # (h, w) valid output extent
@@ -92,8 +92,11 @@ class _Group:
     resample_out: Optional[Tuple[int, int]]
     pad_canvas: Optional[Tuple[int, int]]
     pad_offset: Tuple[int, int]
-    device_plan: TransformPlan
+    device_plan: Optional[TransformPlan]
     members: List[_Pending] = field(default_factory=list)
+    # aux groups (e.g. batched smart-crop scoring) run this instead of the
+    # vmapped transform program: runner(payloads) -> results, one per member
+    runner: Optional[callable] = None
 
 
 class BatchController:
@@ -191,6 +194,8 @@ class BatchController:
             needs_slice=needs_slice,
         )
         with self._lock:
+            if self._stop:
+                raise RuntimeError("batcher is closed")
             group = self._groups.get(key)
             if group is None:
                 group = _Group(
@@ -202,6 +207,41 @@ class BatchController:
                     device_plan=device_plan,
                 )
                 self._groups[key] = group
+            group.members.append(pending)
+            self._lock.notify()
+        return future
+
+    def submit_aux(self, key: Tuple, payload, runner) -> Future:
+        """Queue one item for a batched AUXILIARY program (smart-crop
+        scoring, face detection, ...): concurrent submissions sharing
+        ``(runner, key)`` execute as ONE ``runner(payloads)`` call on the
+        executor thread, under the same flush policy as transform groups.
+        ``runner`` must be a stable module-level callable (it is part of
+        the group key) returning one result per payload, in order."""
+        future: Future = Future()
+        pending = _Pending(
+            image=payload,
+            plan=None,
+            future=future,
+            enqueued_at=time.monotonic(),
+            out_true=(0, 0),
+        )
+        full_key = ("aux", runner, key)
+        with self._lock:
+            if self._stop:
+                raise RuntimeError("batcher is closed")
+            group = self._groups.get(full_key)
+            if group is None:
+                group = _Group(
+                    key=full_key,
+                    in_shape=(0, 0),
+                    resample_out=None,
+                    pad_canvas=None,
+                    pad_offset=(0, 0),
+                    device_plan=None,
+                    runner=runner,
+                )
+                self._groups[full_key] = group
             group.members.append(pending)
             self._lock.notify()
         return future
@@ -305,6 +345,7 @@ class BatchController:
             pad_offset=group.pad_offset,
             device_plan=group.device_plan,
             members=take,
+            runner=group.runner,
         )
         return ready
 
@@ -313,6 +354,32 @@ class BatchController:
     def _execute(self, group: _Group) -> None:
         members = group.members
         n = len(members)
+        if group.runner is not None:
+            try:
+                outputs = group.runner([m.image for m in members])
+                if len(outputs) != n:
+                    raise RuntimeError(
+                        f"aux runner returned {len(outputs)} results for "
+                        f"{n} payloads"
+                    )
+                # aux items are requests already counted by their transform
+                # batch — separate counters so images_processed/occupancy
+                # keep meaning "images through the transform pipeline"
+                self.metrics.counter(
+                    "flyimg_aux_batches_total",
+                    "Batched auxiliary (scoring/detection) launches",
+                ).inc()
+                self.metrics.counter(
+                    "flyimg_aux_items_total",
+                    "Items through batched auxiliary programs",
+                ).inc(n)
+                for member, result in zip(members, outputs):
+                    member.future.set_result(result)
+            except Exception as exc:
+                for member in members:
+                    if not member.future.done():
+                        member.future.set_exception(exc)
+            return
         # sharded execution needs the batch divisible by the data axis —
         # round the ladder size up to a multiple of it (device counts are
         # not necessarily powers of two)

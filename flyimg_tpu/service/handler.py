@@ -82,6 +82,9 @@ class ProcessedImage:
 class ImageHandler:
     # inputs at least this tall consider the spatially-tiled resample
     TILE_MIN_ROWS = 2048
+    # ceiling on any single wait for a batched device result; a wedged
+    # executor then surfaces as a 500 instead of a stuck worker thread
+    DEVICE_RESULT_TIMEOUT_S = 120.0
 
     def __init__(
         self,
@@ -308,7 +311,9 @@ class ImageHandler:
                 # device launch; .result() parks this worker thread while
                 # the group fills (flyimg_tpu/runtime/batcher.py)
                 out_frames.append(
-                    self.batcher.submit(frame, frame_plan).result()
+                    self.batcher.submit(frame, frame_plan).result(
+                        timeout=self.DEVICE_RESULT_TIMEOUT_S
+                    )
                 )
             else:
                 out_frames.append(run_plan(frame, frame_plan))
@@ -321,17 +326,37 @@ class ImageHandler:
             out = out_frames[0]
             if plan.smart_crop:
                 t = time.perf_counter()
-                out = self._smartcrop().smart_crop_image(out)
+                sc = self._smartcrop()
+                if self.batcher is not None and hasattr(sc, "prepare_work"):
+                    # concurrent smc_1 requests score in ONE batched device
+                    # launch per work-shape bucket — the same program shape
+                    # bench.py measures; the per-image path would recompile
+                    # analyse_features for every distinct post-resize size
+                    item = sc.prepare_work(out)
+                    crop = self.batcher.submit_aux(
+                        ("smc", item.bucket, item.step),
+                        item,
+                        sc.find_best_crops_batched,
+                    ).result(timeout=self.DEVICE_RESULT_TIMEOUT_S)
+                    out = sc.apply_crop(out, crop)
+                else:
+                    out = sc.smart_crop_image(out)
                 timings["smartcrop"] = time.perf_counter() - t
             if plan.face_blur or plan.face_crop:
                 t = time.perf_counter()
-                faces = self._faces().detect_faces(out)
+                ff = self._faces()
+                if self.batcher is not None and hasattr(ff, "prepare_face_work"):
+                    # batched detection: one mask program per shape bucket
+                    item = ff.prepare_face_work(out)
+                    faces = self.batcher.submit_aux(
+                        ("face", item.bucket), item, ff.detect_faces_batched
+                    ).result(timeout=self.DEVICE_RESULT_TIMEOUT_S)
+                else:
+                    faces = ff.detect_faces(out)
                 if plan.face_blur:
-                    out = self._faces().blur_faces(out, faces)
+                    out = ff.blur_faces(out, faces)
                 if plan.face_crop:
-                    out = self._faces().crop_face(
-                        out, faces, plan.face_crop_position
-                    )
+                    out = ff.crop_face(out, faces, plan.face_crop_position)
                 timings["faces"] = time.perf_counter() - t
             out_frames = [out]
 

@@ -200,3 +200,50 @@ def test_lone_request_flushes_before_deadline():
         assert elapsed < 1.0, f"lone request waited {elapsed:.2f}s (deadline 2s)"
     finally:
         ctrl.close()
+
+
+def test_aux_group_batches_and_orders():
+    calls = []
+
+    def runner(payloads):
+        calls.append(list(payloads))
+        return [p * 2 for p in payloads]
+
+    ctl = BatchController(max_batch=3, deadline_ms=10_000.0, lone_flush=False)
+    try:
+        futures = [ctl.submit_aux(("toy",), i, runner) for i in range(3)]
+        assert [f.result(timeout=30) for f in futures] == [0, 2, 4]
+        assert calls == [[0, 1, 2]]  # ONE grouped call, submission order
+        summary = ctl.metrics.summary()
+        # aux work is accounted separately from transform batches
+        assert summary.get("flyimg_aux_batches_total") == 1.0
+        assert summary.get("flyimg_aux_items_total") == 3.0
+        assert ctl.stats()["batches"] == 0.0
+    finally:
+        ctl.close()
+
+
+def test_aux_runner_failure_propagates():
+    def runner(payloads):
+        raise RuntimeError("boom")
+
+    ctl = BatchController(max_batch=2, deadline_ms=10_000.0, lone_flush=False)
+    try:
+        futures = [ctl.submit_aux(("bad",), i, runner) for i in range(2)]
+        for f in futures:
+            with pytest.raises(RuntimeError, match="boom"):
+                f.result(timeout=30)
+    finally:
+        ctl.close()
+
+
+def test_aux_and_transform_groups_coexist(controller):
+    def runner(payloads):
+        return [p + 1 for p in payloads]
+
+    img = make_test_image(600, 400, seed=3)
+    plan = _plan("w_200,h_150,c_1", 600, 400)
+    f_transform = controller.submit(img, plan)
+    f_aux = controller.submit_aux(("inc",), 41, runner)
+    assert f_aux.result(timeout=120) == 42
+    assert f_transform.result(timeout=120).shape == (150, 200, 3)

@@ -383,3 +383,72 @@ def test_short_or_cropfill_inputs_skip_tiling(tmp_path):
     ).save(tall)
     handler.process_image("w_100,h_100,c_1,o_jpg", tall)  # crop window
     assert "flyimg_tiled_resamples_total" not in metrics.summary()
+
+
+def test_batched_postpasses_match_direct(tmp_path):
+    """smc_1 / fb_1 through a handler WITH a batcher must produce the same
+    bytes as the direct per-image path, with concurrent smc requests
+    sharing one batched scoring launch (batches << images)."""
+    import threading
+
+    from flyimg_tpu.runtime.batcher import BatchController
+
+    def make(batcher):
+        params = AppParameters(
+            {
+                "upload_dir": str(tmp_path / ("u-b" if batcher else "u-d")),
+                "tmp_dir": str(tmp_path / ("t-b" if batcher else "t-d")),
+            }
+        )
+        storage = make_storage(params)
+        return ImageHandler(storage, params, batcher=batcher), storage
+
+    rng = np.random.default_rng(9)
+    sources = []
+    for i in range(4):
+        arr = rng.integers(0, 255, (240, 320, 3), dtype=np.uint8)
+        arr[40:120, 60 + 10 * i : 140 + 10 * i] = (210, 150, 120)
+        src = str(tmp_path / f"in{i}.png")
+        Image.fromarray(arr).save(src)
+        sources.append(src)
+
+    direct, _ = make(None)
+    expected = [
+        direct.process_image("smc_1,o_png", src).content
+        for i, src in enumerate(sources)
+    ]
+    expected_face = direct.process_image("fb_1,o_png", sources[0]).content
+
+    batcher = BatchController(max_batch=8, deadline_ms=40.0)
+    try:
+        handler, _ = make(batcher)
+        results = [None] * len(sources)
+
+        def run(i, src):
+            results[i] = handler.process_image(
+                "smc_1,o_png", src
+            ).content
+
+        threads = [
+            threading.Thread(target=run, args=(i, src))
+            for i, src in enumerate(sources)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert results == expected
+        assert (
+            handler.process_image("fb_1,o_png", sources[0]).content
+            == expected_face
+        )
+        stats = batcher.stats()
+        summary = batcher.metrics.summary()
+        # 4 smc transforms + 1 fb_1 transform through the device...
+        assert stats["images"] == 5.0
+        # ...and the 4 concurrent smc scoring passes + 1 face detection
+        # coalesced into fewer aux launches than items
+        assert summary.get("flyimg_aux_items_total") == 5.0
+        assert summary.get("flyimg_aux_batches_total") < 5.0
+    finally:
+        batcher.close()

@@ -129,3 +129,50 @@ def test_tiny_image_degenerates_to_whole():
     img = np.full((6, 6, 3), 99, dtype=np.uint8)
     crop = sc.find_best_crop(img, 100, 100)
     assert (crop["width"], crop["height"]) in {(6, 6)} or crop["width"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# batched serving path
+# ---------------------------------------------------------------------------
+
+
+def test_batched_crops_match_single_path():
+    """find_best_crops_batched must return exactly the per-image
+    find_best_crop result: the bucket/kernel zero padding is score-neutral
+    by construction."""
+    from flyimg_tpu.models.smartcrop import (
+        find_best_crop,
+        find_best_crops_batched,
+        prepare_work,
+    )
+
+    rng = np.random.default_rng(7)
+    images = [
+        rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+        for h, w in [(250, 300), (200, 200), (113, 200), (400, 250), (250, 300)]
+    ]
+    # structured saliency so argmax is not a degenerate tie
+    for img in images:
+        hh, ww = img.shape[:2]
+        img[hh // 4 : hh // 2, ww // 4 : ww // 2] = (220, 160, 130)
+
+    batched = find_best_crops_batched([prepare_work(img) for img in images])
+    singles = [find_best_crop(img, 100, 100, use_pallas=False) for img in images]
+    assert batched == singles
+
+
+def test_batched_mixed_buckets_and_small_images():
+    from flyimg_tpu.models.smartcrop import (
+        find_best_crop,
+        find_best_crops_batched,
+        prepare_work,
+    )
+
+    rng = np.random.default_rng(11)
+    images = [
+        rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+        for h, w in [(80, 120), (500, 150), (120, 120)]
+    ]
+    batched = find_best_crops_batched([prepare_work(img) for img in images])
+    singles = [find_best_crop(img, 100, 100, use_pallas=False) for img in images]
+    assert batched == singles
