@@ -17,8 +17,10 @@ RUN make -C flyimg_tpu/codecs/native
 
 FROM python:3.12-slim
 
+# ghostscript: the PDF rasterizer (reference Dockerfile:5 — pg_/dnst_
+# options 415 without it); ffmpeg: the video frame-extraction fallback
 RUN apt-get update && apt-get install -y --no-install-recommends \
-        libjpeg62-turbo libpng16-16 libwebp7 \
+        libjpeg62-turbo libpng16-16 libwebp7 ghostscript ffmpeg \
     && rm -rf /var/lib/apt/lists/*
 
 WORKDIR /app

@@ -26,6 +26,7 @@ Prints exactly ONE JSON line.
 """
 
 import json
+import os
 import sys
 import time
 
@@ -38,28 +39,57 @@ WARMUP = 2
 TARGET_PER_CHIP = 10_000 / 8.0
 
 
+PROBE_TIMEOUT_S = float(os.environ.get("FLYIMG_BENCH_PROBE_TIMEOUT", "75"))
+
+
+def _probe_backend(timeout_s: float = PROBE_TIMEOUT_S) -> bool:
+    """Probe backend init in a SUBPROCESS: a flaky TPU tunnel can make
+    client creation hang indefinitely (not just raise), and a hung C-API
+    call inside this process could never be cancelled. Poll rather than
+    subprocess.run(timeout=...): a tunnel-hung child can sit in
+    uninterruptible kernel I/O where even SIGKILL doesn't reap it, and
+    run()'s post-kill wait would then hang the parent too — kill best-
+    effort and ABANDON the child instead."""
+    import subprocess
+
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import jax; jax.default_backend()"],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        rc = proc.poll()
+        if rc is not None:
+            return rc == 0
+        time.sleep(1.0)
+    proc.kill()
+    return False
+
+
 def _init_backend():
     """Initialize the jax backend, riding out transient TPU flakiness.
 
-    The dev harness's TPU tunnel can be temporarily unavailable (round-1
-    bench died rc=1 on exactly this). Retry TPU a few times; if it stays
-    down, fall back to CPU so the bench always emits its one JSON line.
+    The dev harness's TPU tunnel can be temporarily unavailable — round-1
+    bench died rc=1 on an init error, and the tunnel has also been seen
+    hanging client creation outright. Probe out-of-process with retries;
+    if the default backend stays unreachable, force CPU so the bench
+    always emits its one JSON line.
     """
+    for attempt in range(3):
+        if _probe_backend():
+            break
+        if attempt < 2:
+            time.sleep(5 * (attempt + 1))
+    else:
+        from flyimg_tpu.parallel.mesh import force_cpu_platform
+
+        force_cpu_platform(1)
+        print("# default backend unreachable (probe failed 3x); CPU fallback",
+              file=sys.stderr)
+
     import jax
 
-    last = None
-    for attempt in range(3):
-        try:
-            return jax.default_backend()
-        except Exception as exc:  # backend init failure — retry
-            last = exc
-            if attempt < 2:
-                time.sleep(3 * (attempt + 1))
-    from flyimg_tpu.parallel.mesh import force_cpu_platform
-
-    force_cpu_platform(1)
-    print(f"# TPU backend unavailable after retries ({last}); CPU fallback",
-          file=sys.stderr)
     return jax.default_backend()
 
 

@@ -179,23 +179,12 @@ def find_best_crop(
     use_pallas: bool | None = None,
 ) -> Dict[str, int]:
     """Best crop of [h, w, 3] uint8 -> dict(x, y, width, height), in source
-    pixel coords. Mirrors SmartCrop.crop() including prescale bookkeeping."""
-    img_h, img_w = rgb.shape[:2]
-    scale = min(img_w / target_w, img_h / target_h)
-    crop_w = int(math.floor(target_w * scale))
-    crop_h = int(math.floor(target_h * scale))
-    min_scale = min(max_scale, max(1.0 / scale, min_scale))
-
-    prescale_size = 1.0 / scale / min_scale
-    work = rgb
-    if prescale_size < 1.0:
-        new_w = int(img_w * prescale_size)
-        new_h = int(img_h * prescale_size)
-        work = _host_thumbnail(rgb, new_w, new_h)
-        crop_w = int(math.floor(crop_w * prescale_size))
-        crop_h = int(math.floor(crop_h * prescale_size))
-    else:
-        prescale_size = 1.0
+    pixel coords. Mirrors SmartCrop.crop() including prescale bookkeeping
+    (one implementation, shared with the batched path: prepare_work)."""
+    item = prepare_work(
+        rgb, target_w, target_h, min_scale=min_scale, max_scale=max_scale,
+        scale_step=scale_step, step=step,
+    )
 
     # the weighted scoring field, computed ONCE and reused across scales.
     # The XLA feature-map path is canonical: measured on-chip it matches
@@ -203,57 +192,33 @@ def find_best_crop(
     # small-stencil chain itself), and it is bit-identical to the batched
     # serving path, where the Pallas field differs by up to ~7e-3 (enough
     # to flip an argmax near-tie). Pallas stays as an explicit opt-in.
-    if use_pallas is None:
-        use_pallas = False
     if use_pallas:
         from flyimg_tpu.ops.pallas_kernels import saliency_field
 
-        weighted = saliency_field(jnp.asarray(work))
+        weighted = saliency_field(jnp.asarray(item.work))
     else:
-        weighted = weighted_field(analyse_features(jnp.asarray(work)))
+        weighted = weighted_field(analyse_features(jnp.asarray(item.work)))
 
-    work_h, work_w = work.shape[:2]
     best = None
-    # scales 1.0 -> min_scale step 0.1 (int grid like the reference's
-    # range(int(max*100), int((min-step)*100), -int(step*100)))
-    for scale_pct in range(
-        int(max_scale * 100),
-        int((min_scale - scale_step) * 100),
-        -int(scale_step * 100),
-    ):
-        s = scale_pct / 100.0
-        cw = crop_w * s
-        ch = crop_h * s
-        if cw < 1.0 or ch < 1.0:
+    for s in item.scales:
+        geom = _member_scale_geometry(item, s)
+        if geom is None:
             continue
-        # candidate grid: x, y multiples of `step` with x + cw <= W (float
-        # compare like the reference's crops() loop guards)
-        max_x = int((work_w - cw) // step) * step
-        max_y = int((work_h - ch) // step) * step
-        if max_x < 0 or max_y < 0:
-            continue
-        scores = np.asarray(score_grid_from_weighted(weighted, cw, ch, stride=step))
-        ny = max_y // step + 1
-        nx = max_x // step + 1
+        cw, ch, max_x, max_y = geom
+        scores = np.asarray(
+            score_grid_from_weighted(weighted, cw, ch, stride=item.step)
+        )
+        ny = max_y // item.step + 1
+        nx = max_x // item.step + 1
         sub = scores[:ny, :nx]
         if sub.size == 0:
             continue
         idx = np.unravel_index(np.argmax(sub), sub.shape)
         top = float(sub[idx])
         if best is None or top > best[0]:
-            best = (top, idx[1] * step, idx[0] * step, cw, ch)
+            best = (top, idx[1] * item.step, idx[0] * item.step, cw, ch)
 
-    if best is None:
-        # degenerate image smaller than any candidate: whole image
-        return {"x": 0, "y": 0, "width": img_w, "height": img_h}
-
-    _, x, y, cw, ch = best
-    return {
-        "x": int(math.floor(x / prescale_size)),
-        "y": int(math.floor(y / prescale_size)),
-        "width": int(math.floor(cw / prescale_size)),
-        "height": int(math.floor(ch / prescale_size)),
-    }
+    return _crop_from_best(best, item)
 
 
 def _host_thumbnail(rgb: np.ndarray, w: int, h: int) -> np.ndarray:
@@ -467,6 +432,21 @@ def _batched_scores(weighted: jnp.ndarray, kernels: jnp.ndarray, stride: int):
     return grids, totals
 
 
+def _crop_from_best(best, item: WorkItem) -> Dict[str, int]:
+    """(score, x, y, cw, ch) in work coords -> source-coords crop dict;
+    None (degenerate image smaller than any candidate) -> whole image."""
+    if best is None:
+        return {"x": 0, "y": 0, "width": item.img_w, "height": item.img_h}
+    _, x, y, cw, ch = best
+    ps = item.prescale_size
+    return {
+        "x": int(math.floor(x / ps)),
+        "y": int(math.floor(y / ps)),
+        "width": int(math.floor(cw / ps)),
+        "height": int(math.floor(ch / ps)),
+    }
+
+
 def _member_scale_geometry(item: WorkItem, s: float):
     """(cw, ch, max_x, max_y) for one candidate scale, or None when the
     scale is skipped (find_best_crop's `continue` guards)."""
@@ -582,19 +562,5 @@ def _run_bucket(
             top = float(scores[idx])
             if best is None or top > best[0]:
                 best = (top, idx[1] * step, idx[0] * step, cw, ch)
-        if best is None:
-            out.append(
-                {"x": 0, "y": 0, "width": item.img_w, "height": item.img_h}
-            )
-            continue
-        _, x, y, cw, ch = best
-        ps = item.prescale_size
-        out.append(
-            {
-                "x": int(math.floor(x / ps)),
-                "y": int(math.floor(y / ps)),
-                "width": int(math.floor(cw / ps)),
-                "height": int(math.floor(ch / ps)),
-            }
-        )
+        out.append(_crop_from_best(best, item))
     return out

@@ -15,12 +15,15 @@ kernel that vectorizes.
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 # Rec.709 luma — what IM uses for '-colorspace Gray' (sRGB-companded luma)
 LUMA_WEIGHTS = (0.212656, 0.715158, 0.072186)
 
-# canonical 8x8 Bayer matrix, values 0..63
-_BAYER8 = jnp.array(
+# canonical 8x8 Bayer matrix, values 0..63 — a HOST constant: a module-level
+# jnp.array would initialize the device backend at import time, which wedges
+# every process (even CPU-only test runs) when the TPU tunnel is down
+_BAYER8 = np.array(
     [
         [0, 32, 8, 40, 2, 34, 10, 42],
         [48, 16, 56, 24, 50, 18, 58, 26],
@@ -31,7 +34,7 @@ _BAYER8 = jnp.array(
         [15, 47, 7, 39, 13, 45, 5, 37],
         [63, 31, 55, 23, 61, 29, 53, 21],
     ],
-    dtype=jnp.float32,
+    dtype=np.float32,
 )
 
 
@@ -47,7 +50,7 @@ def monochrome_dither(image: jnp.ndarray) -> jnp.ndarray:
     weights = jnp.array(LUMA_WEIGHTS, dtype=image.dtype)
     luma = jnp.tensordot(image, weights, axes=([-1], [0]))
     h, w = luma.shape[-2], luma.shape[-1]
-    tile = jnp.tile(_BAYER8, (h // 8 + 1, w // 8 + 1))[:h, :w]
+    tile = jnp.tile(jnp.asarray(_BAYER8), (h // 8 + 1, w // 8 + 1))[:h, :w]
     threshold = (tile + 0.5) * (255.0 / 64.0)
     bw = jnp.where(luma > threshold, 255.0, 0.0)
     return jnp.broadcast_to(bw[..., None], image.shape).astype(image.dtype)

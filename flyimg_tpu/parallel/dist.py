@@ -17,6 +17,18 @@ from typing import Optional
 import jax
 
 
+def _gce_metadata_reachable(timeout_s: float = 1.0) -> bool:
+    """Bounded probe for the GCE metadata server (the peer-discovery
+    channel on plain Cloud TPU slices). Fails fast on dev boxes."""
+    import socket
+
+    try:
+        with socket.create_connection(("169.254.169.254", 80), timeout=timeout_s):
+            return True
+    except OSError:
+        return False
+
+
 def initialize_multihost(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -42,11 +54,27 @@ def initialize_multihost(
         int(env_pid) if env_pid else None
     )
     if coordinator_address is None and num_processes is None:
-        # Nothing configured: try cloud-metadata autodetection. Must NOT
-        # probe jax.default_backend() first — that initializes the local
-        # backend, after which jax.distributed.initialize() always raises
-        # ("must be called before any JAX computations") and a real pod
-        # would silently come up single-host.
+        # Nothing configured: autodetect ONLY when the environment looks
+        # like a pod — an env marker (set on GKE / most Cloud TPU setups)
+        # or a reachable GCE metadata server (plain gcloud-created slices,
+        # where JAX autodetects peers via metadata, not env). On a dev box
+        # with neither, jax.distributed.initialize() can BLOCK for minutes
+        # waiting on that metadata service instead of raising, which would
+        # wedge `serve` before it ever binds its port.
+        markers = (
+            "JAX_COORDINATOR_ADDRESS",
+            "JAX_NUM_PROCESSES",
+            "TPU_WORKER_HOSTNAMES",
+            "TPU_WORKER_ID",
+            "CLOUD_TPU_TASK_ID",
+            "MEGASCALE_COORDINATOR_ADDRESS",
+        )
+        if not any(m in os.environ for m in markers) and not _gce_metadata_reachable():
+            return False
+        # Must NOT probe jax.default_backend() first — that initializes the
+        # local backend, after which jax.distributed.initialize() always
+        # raises ("must be called before any JAX computations") and a real
+        # pod would silently come up single-host.
         try:
             jax.distributed.initialize()
             return True

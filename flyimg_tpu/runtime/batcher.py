@@ -33,19 +33,19 @@ import numpy as np
 
 from flyimg_tpu.ops.compose import (
     _bucket_dim,
+    bucket_batch,
     make_program_fn,
     plan_layout,
 )
 from flyimg_tpu.spec.plan import TransformPlan
 
-BATCH_SIZE_LADDER = (1, 2, 4, 8, 16, 32, 64)
+MAX_BATCH_BUCKET = 64
 
 
 def _round_batch(n: int) -> int:
-    for size in BATCH_SIZE_LADDER:
-        if n <= size:
-            return size
-    return BATCH_SIZE_LADDER[-1]
+    """The shared power-of-two occupancy ladder, capped: groups never
+    exceed max_batch (<= 64 by default) members anyway."""
+    return min(bucket_batch(n), MAX_BATCH_BUCKET)
 
 
 @lru_cache(maxsize=256)

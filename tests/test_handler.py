@@ -419,7 +419,9 @@ def test_batched_postpasses_match_direct(tmp_path):
     ]
     expected_face = direct.process_image("fb_1,o_png", sources[0]).content
 
-    batcher = BatchController(max_batch=8, deadline_ms=40.0)
+    # lone_flush off: with it on, staggered thread scheduling could legally
+    # flush each aux item as its own singleton batch (timing-dependent)
+    batcher = BatchController(max_batch=8, deadline_ms=40.0, lone_flush=False)
     try:
         handler, _ = make(batcher)
         results = [None] * len(sources)

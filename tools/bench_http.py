@@ -39,11 +39,11 @@ SCENARIOS = [
 ]
 
 
-def _make_source(path: str) -> str:
+def _make_source(path: str, seed: int = 42) -> str:
     from PIL import Image
 
     if not os.path.exists(path):
-        rng = np.random.default_rng(42)
+        rng = np.random.default_rng(seed)
         arr = rng.integers(0, 256, size=(768, 1024, 3), dtype=np.uint8)
         parent = os.path.dirname(path)
         if parent:
@@ -115,6 +115,40 @@ async def _burst_run(client: httpx.AsyncClient, url: str, total: int, conc: int)
     return latencies, failures, elapsed
 
 
+async def _miss_run(
+    client: httpx.AsyncClient, urls: list, conc: int
+):
+    """Cache-MISS path: every URL is a distinct uncached output, requested
+    exactly once by `conc` closed-loop workers — each request runs the full
+    fetch/decode/device/encode pipeline (concurrent misses batch in the
+    runtime; none coalesce, the keys are all different)."""
+    latencies: list = []
+    failures = 0
+    it = iter(urls)
+
+    async def worker():
+        nonlocal failures
+        while True:
+            url = next(it, None)
+            if url is None:
+                return
+            t0 = time.perf_counter()
+            try:
+                resp = await client.get(url)
+                ok = resp.status_code == 200 and len(resp.content) > 0
+            except httpx.HTTPError:
+                ok = False
+            if ok:
+                latencies.append(time.perf_counter() - t0)
+            else:
+                failures += 1
+
+    start = time.perf_counter()
+    await asyncio.gather(*[worker() for _ in range(conc)])
+    elapsed = time.perf_counter() - start
+    return latencies, failures, elapsed
+
+
 def _report(name: str, mode: str, lat, failures: int, elapsed: float):
     if not lat:
         print(f"{name:8s} {mode:6s}  ALL {failures} REQUESTS FAILED")
@@ -157,6 +191,16 @@ async def main() -> int:
     ap.add_argument("--duration", type=float, default=10.0)
     ap.add_argument("--burst", type=int, default=2000, help="burst request count (0=skip)")
     ap.add_argument("--conc", type=int, default=32, help="burst concurrency")
+    ap.add_argument(
+        "--miss", type=int, default=0,
+        help="cache-miss scenario: N distinct sources, each a fresh "
+             "full-pipeline request (0=skip)",
+    )
+    ap.add_argument(
+        "--miss-warm", type=int, default=64,
+        help="throwaway miss requests first, so the batch-size ladder's "
+             "programs are compiled before measurement",
+    )
     ap.add_argument("--spawn", action="store_true", help="start the service here")
     ap.add_argument("--source", default="var/tmp/bench-source.jpg")
     args = ap.parse_args()
@@ -221,6 +265,29 @@ async def main() -> int:
                         client, url, args.burst, args.conc
                     )
                     _report(name, "burst", lat, fails, elapsed)
+
+            if args.miss:
+                # distinct sources (same dims -> one shape bucket) so every
+                # request is an uncoalescible cache miss; seed 1000+ avoids
+                # colliding with the shared cache-hit source
+                src_dir = os.path.dirname(args.source) or "."
+                miss_srcs = [
+                    _make_source(
+                        os.path.join(src_dir, f"bench-miss-{i}.jpg"),
+                        seed=1000 + i,
+                    )
+                    for i in range(args.miss_warm + args.miss)
+                ]
+                options = SCENARIOS[0][1]  # crop, the reference's headline
+                urls = [
+                    f"{base}/upload/{options}/{s}" for s in miss_srcs
+                ]
+                if args.miss_warm:
+                    await _miss_run(client, urls[: args.miss_warm], args.conc)
+                lat, fails, elapsed = await _miss_run(
+                    client, urls[args.miss_warm:], args.conc
+                )
+                _report("miss", "burst", lat, fails, elapsed)
     finally:
         if proc is not None:
             proc.send_signal(signal.SIGTERM)
