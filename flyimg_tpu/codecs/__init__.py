@@ -54,7 +54,9 @@ def decode(
     frame: int = 0,
 ) -> DecodedImage:
     """Decode bytes -> DecodedImage. JPEG/WebP ride the native codec when
-    built; everything else (and all alpha/animation handling) uses PIL."""
+    built; everything else (and all alpha/animation handling) uses PIL.
+    Alpha sources keep RAW rgb + a separate alpha plane; the handler
+    flattens over the bg_ color only where alpha is actually dropped."""
     info = media_info(data)
     if native_codec.available():
         if info.mime == "image/jpeg":
@@ -84,7 +86,7 @@ def decode(
             decoded = native_codec.png_decode(data)
             if decoded is not None:
                 pixels, channels = decoded
-                alpha = pixels[..., 3] if channels == 4 else None
+                alpha = pixels[..., 3].copy() if channels == 4 else None
                 rgb = np.ascontiguousarray(pixels[..., :3])
                 return DecodedImage(
                     rgb=rgb,

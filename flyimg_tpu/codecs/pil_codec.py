@@ -54,6 +54,8 @@ def decode(
     """Decode bytes -> RGB array. ``target_hint`` (w, h) enables JPEG DCT
     prescale when the target is much smaller than the source. ``frame``
     selects a GIF frame (reference gif-frame option, ImageProcessor.php:171-186).
+    RGB stays RAW (unflattened) for alpha sources; the pipeline flattens
+    over the bg_ color only where the alpha channel is actually dropped.
     """
     img = Image.open(io.BytesIO(data))
     mime = Image.MIME.get(img.format or "", "application/octet-stream")
@@ -80,10 +82,7 @@ def decode(
         rgba = img.convert("RGBA")
         arr = np.asarray(rgba)
         alpha = arr[..., 3].copy()
-        a = arr[..., 3:4].astype(np.float32) / 255.0
-        rgb = (
-            arr[..., :3].astype(np.float32) * a + 255.0 * (1.0 - a)
-        ).round().astype(np.uint8)
+        rgb = arr[..., :3].copy()
     else:
         rgb = np.asarray(img.convert("RGB")).copy()
 

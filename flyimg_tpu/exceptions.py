@@ -40,3 +40,10 @@ class UnsupportedMediaException(AppException):
     (e.g. video without ffmpeg, PDF without ghostscript). Not present in the
     reference (its Docker image bundles those binaries); this framework gates
     them at runtime instead."""
+
+
+class ServiceUnavailableException(AppException):
+    """The device pipeline did not produce a result in time (wedged
+    executor or a coalesced leader that never completed). Maps to 503 so
+    load balancers shed/retry instead of holding sockets open. No reference
+    analog (its per-request exec model cannot wedge followers)."""

@@ -35,6 +35,7 @@ from flyimg_tpu.exceptions import (
     InvalidArgumentException,
     ReadFileException,
     SecurityException,
+    ServiceUnavailableException,
     UnsupportedMediaException,
 )
 from flyimg_tpu.service.handler import ImageHandler
@@ -58,6 +59,7 @@ _ERROR_STATUS = {
     ReadFileException: 404,
     InvalidArgumentException: 400,
     UnsupportedMediaException: 415,
+    ServiceUnavailableException: 503,
     ExecFailedException: 500,
 }
 

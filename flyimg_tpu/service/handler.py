@@ -23,7 +23,7 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from flyimg_tpu.appconfig import AppParameters
-from flyimg_tpu.codecs import decode, encode
+from flyimg_tpu.codecs import decode, encode, media_info
 from flyimg_tpu.exceptions import AppException
 from flyimg_tpu.ops.compose import run_plan
 from flyimg_tpu.service.input_source import load_source
@@ -180,8 +180,25 @@ class ImageHandler:
         leader, flight = self._singleflight.begin(spec.name)
         if not leader:
             # another request is already computing these exact bytes;
-            # wait for it instead of running a duplicate device pipeline
-            content = flight.result()
+            # wait for it instead of running a duplicate device pipeline —
+            # but never forever: a wedged leader must shed followers as
+            # 503s, not strand every coalesced request
+            from concurrent.futures import TimeoutError as FutureTimeout
+
+            from flyimg_tpu.exceptions import ServiceUnavailableException
+
+            try:
+                # generous multiple of the per-device-call budget: a slow
+                # but healthy leader (multi-frame GIF, several post-pass
+                # waits) must NOT shed its followers — only a wedged one
+                content = flight.result(
+                    timeout=5 * self.DEVICE_RESULT_TIMEOUT_S
+                )
+            except FutureTimeout:
+                raise ServiceUnavailableException(
+                    "timed out waiting for the in-flight pipeline computing "
+                    "this output"
+                ) from None
             timings["coalesced"] = time.perf_counter() - t0
             timings["total"] = timings["coalesced"]
             if self.metrics is not None:
@@ -296,6 +313,28 @@ class ImageHandler:
         if is_animated_gif_out and decoded.n_frames > 1:
             frames, durations = _decode_all_frames(data)
 
+        # Alpha survives to the output only when no op changes geometry and
+        # the format carries it; everywhere else flatten the RAW rgb over
+        # the bg_ color now (IM flattens over -background,
+        # ImageProcessor.php:95-101 — not hardcoded white).
+        keeps_alpha = (
+            decoded.alpha is not None
+            and plan.resize_to is None and plan.extent is None
+            and plan.extract is None and plan.rotate is None
+            and not plan.smart_crop
+            and not plan.face_blur and not plan.face_crop
+            and not (is_animated_gif_out and decoded.n_frames > 1)
+            and spec.extension in ("png", "webp")
+        )
+        if decoded.alpha is not None and not keeps_alpha and len(frames) == 1:
+            a = decoded.alpha[..., None].astype(np.float32) / 255.0
+            bg = np.asarray(plan.background or (255, 255, 255), np.float32)
+            frames = [
+                np.round(
+                    frames[0].astype(np.float32) * a + bg * (1.0 - a)
+                ).astype(np.uint8)
+            ]
+
         t = time.perf_counter()
         out_frames = []
         for frame in frames:
@@ -361,10 +400,11 @@ class ImageHandler:
             out_frames = [out]
 
         t = time.perf_counter()
+        # attach-time decision mirrors keeps_alpha (the flatten decision):
+        # attaching alpha to rgb that was already flattened over bg would
+        # double-composite semi-transparent pixels
         alpha = None
-        if decoded.alpha is not None and plan.resize_to is None and \
-                plan.extent is None and plan.extract is None and \
-                plan.rotate is None and len(out_frames) == 1 and \
+        if keeps_alpha and len(out_frames) == 1 and \
                 out_frames[0].shape[:2] == decoded.alpha.shape:
             alpha = decoded.alpha
 
@@ -382,6 +422,17 @@ class ImageHandler:
                 alpha=alpha,
             )
         timings["encode"] = time.perf_counter() - t
+
+        # rf_1 debug header payload (reference `identify` line via the
+        # im-identify header, Response.php:62 + Processor.php:71-77),
+        # rebuilt from our own no-decode probe of the encoded bytes
+        out_info = media_info(content)
+        fmt = spec.extension.upper().replace("JPG", "JPEG")
+        spec.identify_repr = (
+            f"{spec.name} {fmt} {out_info.width}x{out_info.height} "
+            f"{out_info.width}x{out_info.height}+0+0 8-bit sRGB "
+            f"{len(content)}B"
+        )
         return content
 
 

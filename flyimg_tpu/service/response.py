@@ -40,6 +40,9 @@ def image_headers(result: ProcessedImage, header_cache_days: int) -> Dict[str, s
         # debug headers (reference Response.php:58-64): the exact device
         # program description stands in for the convert command line
         headers["im-command"] = result.spec.command_repr[:2000]
+        if result.spec.identify_repr:
+            # reference Response.php:62: `identify` line for the output
+            headers["im-identify"] = result.spec.identify_repr[:2000]
         if result.timings:
             headers["x-flyimg-timings"] = ",".join(
                 f"{k}={v * 1000:.1f}ms" for k, v in result.timings.items()

@@ -454,3 +454,50 @@ def test_batched_postpasses_match_direct(tmp_path):
         assert summary.get("flyimg_aux_batches_total") < 5.0
     finally:
         batcher.close()
+
+
+def test_alpha_flattens_over_bg_color(env):
+    """IM flattens alpha over -background (bg_), not hardcoded white;
+    geometry ops drop the alpha channel so the flatten color shows."""
+    handler, _, tmp = env
+    arr = np.zeros((80, 80, 4), dtype=np.uint8)  # fully transparent
+    src = str(tmp / "alpha.png")
+    Image.fromarray(arr).save(src)
+    red = handler.process_image("w_40,bg_red,o_png", src)
+    px = np.asarray(Image.open(io.BytesIO(red.content)).convert("RGB"))
+    assert px[20, 20, 0] > 220 and px[20, 20, 1] < 40
+    white = handler.process_image("w_40,o_png", src)
+    px = np.asarray(Image.open(io.BytesIO(white.content)).convert("RGB"))
+    assert (px[20, 20] > 220).all()  # default stays white
+
+
+def test_singleflight_follower_timeout_returns_503_class(env):
+    """A wedged leader sheds followers with ServiceUnavailableException
+    instead of blocking forever (maps to HTTP 503)."""
+    from concurrent.futures import Future
+
+    from flyimg_tpu.exceptions import ServiceUnavailableException
+
+    handler, _, tmp = env
+    src = _write_png(tmp / "sf.png")
+    handler.DEVICE_RESULT_TIMEOUT_S = 0.2
+    handler._singleflight.begin = lambda key: (False, Future())
+    with pytest.raises(ServiceUnavailableException):
+        handler.process_image("w_30,o_png", src)
+
+
+def test_face_blur_on_alpha_source_flattens_once(env):
+    """Shape-preserving post-passes (fb_1) flatten the alpha source over
+    bg_ and must NOT re-attach the alpha channel — that would
+    double-composite semi-transparent pixels."""
+    handler, _, tmp = env
+    arr = np.zeros((80, 80, 4), dtype=np.uint8)
+    arr[..., 3] = 128  # uniformly semi-transparent black
+    src = str(tmp / "fba.png")
+    Image.fromarray(arr).save(src)
+    result = handler.process_image("fb_1,bg_red,o_png", src)
+    out = Image.open(io.BytesIO(result.content))
+    assert out.mode == "RGB"  # alpha dropped, single flatten
+    px = np.asarray(out)[40, 40]
+    # 50% black over red = (128, 0, 0)
+    assert abs(int(px[0]) - 128) <= 2 and px[1] <= 2

@@ -102,6 +102,9 @@ def test_upload_refresh_debug_headers(tmp_path, source_png):
     assert "no-cache" in headers["Cache-Control"]
     assert "im-command" in headers  # reference Response.php:58-64
     assert "x-flyimg-timings" in headers
+    # reference Response.php:62: the output's `identify` line
+    assert "im-identify" in headers
+    assert "JPEG 20x" in headers["im-identify"]
 
 
 def test_path_route_returns_public_url(tmp_path, source_png):
