@@ -30,7 +30,7 @@ from flyimg_tpu.spec.plan import TransformPlan
 from flyimg_tpu.ops.resample import resample_image
 from flyimg_tpu.ops.filters import gaussian_blur, sharpen as sharpen_op, unsharp_mask
 from flyimg_tpu.ops.color import monochrome_dither, to_grayscale
-from flyimg_tpu.ops.rotate import rotate_image
+from flyimg_tpu.ops.rotate import rotate_image, rotate_image_dynamic
 from flyimg_tpu.ops.pad import extent_pad
 
 
@@ -101,26 +101,42 @@ def make_program_fn(
     pad_canvas: Optional[Tuple[int, int]],
     pad_offset: Tuple[int, int],
     plan: TransformPlan,
+    rotate_dynamic: bool = False,
 ):
     """The raw (unjitted) device program closure for one op config. Shared
     by the single-image path (build_program jits it) and the batch runtime
-    (which vmaps it over a batch axis before jitting)."""
+    (which vmaps it over a batch axis before jitting).
+
+    With ``rotate_dynamic`` the rotate stage runs on a shape-bucketed frame
+    with traced valid dims, so mixed-size rotate traffic shares one
+    executable; ``in_true`` is then [h, w, rot_h, rot_w] — valid input dims
+    plus the host-computed rotated output extent (see final_extent)."""
 
     def program(img_u8, in_true, span_y, span_x, out_true):
         x = img_u8.astype(jnp.float32)
+        cur_true = in_true[:2]
         if resample_out is not None:
             x = resample_image(
-                x, resample_out, span_y, span_x, out_true, in_true,
+                x, resample_out, span_y, span_x, out_true, in_true[:2],
                 method=plan.filter_method,
             )
+            cur_true = out_true
         if pad_canvas is not None:
             x = extent_pad(x, pad_canvas, pad_offset, plan.background)
+            cur_true = jnp.array(
+                (pad_canvas[1], pad_canvas[0]), jnp.float32
+            )
         if plan.colorspace == "gray":
             x = to_grayscale(x)
         if plan.monochrome:
             x = monochrome_dither(x)
         if plan.rotate is not None:
-            x = rotate_image(x, plan.rotate, plan.background)
+            if rotate_dynamic:
+                x = rotate_image_dynamic(
+                    x, plan.rotate, plan.background, cur_true, in_true[2:4]
+                )
+            else:
+                x = rotate_image(x, plan.rotate, plan.background)
         if plan.unsharp is not None:
             r, s, gain, thr = plan.unsharp
             x = unsharp_mask(x, r, s, gain, thr)
@@ -150,6 +166,21 @@ def build_program(
     shape, but keeping it in the key keeps cache entries one-shape."""
     del in_shape
     return jax.jit(make_program_fn(resample_out, pad_canvas, pad_offset, plan))
+
+
+def final_extent(plan: TransformPlan, layout: Layout) -> Tuple[int, int]:
+    """Final valid (h, w) of the program output for one image — what a
+    padded/bucketed output must be sliced to. Follows the stage order:
+    resample valid extent -> extent canvas -> rotated bounds."""
+    from flyimg_tpu.spec.plan import rotated_bounds
+
+    h, w = layout.out_true
+    if layout.pad_canvas is not None:
+        w, h = layout.pad_canvas
+    if plan.rotate is not None:
+        rw, rh = rotated_bounds(w, h, plan.rotate)
+        h, w = rh, rw
+    return (int(h), int(w))
 
 
 def _bucket_dim(size: int, step: int = 128) -> int:

@@ -38,12 +38,45 @@ def rotate_image(
     if quad == 270.0:
         return jnp.flip(jnp.swapaxes(image, 0, 1), axis=0)
 
+    # arbitrary angle: the special case of the dynamic sampler where the
+    # whole static frame is valid (one sampler implementation, not two)
+    h, w = int(image.shape[0]), int(image.shape[1])
+    out_w, out_h = rotated_bounds(w, h, degrees)
+    return rotate_image_dynamic(
+        image, degrees, background,
+        jnp.array((h, w), jnp.float32),
+        jnp.array((out_h, out_w), jnp.float32),
+    )
+
+
+def rotate_image_dynamic(
+    image: jnp.ndarray,
+    degrees: float,
+    background: Optional[Tuple[int, int, int]],
+    true_hw: jnp.ndarray,
+    rot_hw: jnp.ndarray,
+) -> jnp.ndarray:
+    """Rotate the DYNAMIC valid top-left (true_hw) region of a padded
+    static frame — the shape-bucketed batch path, where mixed source sizes
+    share one executable and the per-image geometry rides in as traced
+    scalars (like the windowed resample).
+
+    ``rot_hw`` is the host-computed rotated-bounds (h, w) of the valid
+    region (spec.plan.rotated_bounds — passing the integers in keeps host
+    slicing and device placement exactly aligned, no float re-derivation).
+    Output is the static rotated bounds of the full padded frame; the
+    valid rotated content sits top-left in it, centered on rot_hw, with
+    background fill elsewhere. Same inverse-map bilinear sampling as
+    rotate_image; 90-degree multiples hit integer coordinates, where
+    bilinear degenerates to the exact copy the static path's flips give.
+    """
     h, w = int(image.shape[0]), int(image.shape[1])
     out_w, out_h = rotated_bounds(w, h, degrees)
     bg = jnp.array(background or (255, 255, 255), dtype=image.dtype)
 
-    # inverse map: for each output pixel, the source coordinate that lands
-    # there under a clockwise rotation about the image center
+    th = true_hw[0]
+    tw = true_hw[1]
+    quad = degrees % 360.0
     theta = math.radians(quad)
     cos_t, sin_t = math.cos(theta), math.sin(theta)
     yo, xo = jnp.meshgrid(
@@ -51,12 +84,12 @@ def rotate_image(
         jnp.arange(out_w, dtype=jnp.float32),
         indexing="ij",
     )
-    cy_out, cx_out = (out_h - 1) / 2.0, (out_w - 1) / 2.0
-    cy_in, cx_in = (h - 1) / 2.0, (w - 1) / 2.0
+    cy_out = (rot_hw[0] - 1.0) / 2.0
+    cx_out = (rot_hw[1] - 1.0) / 2.0
+    cy_in = (th - 1.0) / 2.0
+    cx_in = (tw - 1.0) / 2.0
     dx = xo - cx_out
     dy = yo - cy_out
-    # screen coords (y down): clockwise rotation forward = [cos -sin; sin cos];
-    # inverse rotates by -theta
     xs = cos_t * dx + sin_t * dy + cx_in
     ys = -sin_t * dx + cos_t * dy + cy_in
 
@@ -66,8 +99,10 @@ def rotate_image(
     fy = (ys - y0)[..., None]
 
     def gather(yy, xx):
-        yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
-        xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+        # clip to the VALID region (dynamic) so bucket padding is never
+        # sampled; the static bound is implied (true_hw <= frame dims)
+        yc = jnp.clip(yy, 0, th - 1.0).astype(jnp.int32)
+        xc = jnp.clip(xx, 0, tw - 1.0).astype(jnp.int32)
         return image[yc, xc]
 
     p00 = gather(y0, x0)
@@ -79,6 +114,6 @@ def rotate_image(
     sampled = top * (1 - fy) + bot * fy
 
     inside = (
-        (xs >= -0.5) & (xs <= w - 0.5) & (ys >= -0.5) & (ys <= h - 0.5)
+        (xs >= -0.5) & (xs <= tw - 0.5) & (ys >= -0.5) & (ys <= th - 0.5)
     )[..., None]
     return jnp.where(inside, sampled, bg)

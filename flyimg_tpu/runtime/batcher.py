@@ -34,6 +34,7 @@ import numpy as np
 from flyimg_tpu.ops.compose import (
     _bucket_dim,
     bucket_batch,
+    final_extent,
     make_program_fn,
     plan_layout,
 )
@@ -57,12 +58,16 @@ def build_batched_program(
     pad_offset: Tuple[int, int],
     plan: TransformPlan,
     mesh=None,
+    rotate_dynamic: bool = False,
 ):
     """vmap of the single-image program over a static batch axis; with a
     mesh, the batch axis is sharded over its 'data' axis (SPMD fan-out, no
     collectives — each device transforms its slice of the batch)."""
     del batch_size, in_shape  # cache-key components; jit re-specializes
-    inner = make_program_fn(resample_out, pad_canvas, pad_offset, plan)
+    inner = make_program_fn(
+        resample_out, pad_canvas, pad_offset, plan,
+        rotate_dynamic=rotate_dynamic,
+    )
     if mesh is None:
         return jax.jit(jax.vmap(inner))
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -81,8 +86,8 @@ class _Pending:
     plan: Optional[TransformPlan]
     future: Future
     enqueued_at: float
-    out_true: Tuple[int, int]       # (h, w) valid output extent
-    needs_slice: bool = False       # output was bucket-padded; slice out_true
+    final_true: Tuple[int, int]     # final valid (h, w) of the output
+    needs_slice: bool = False       # output is bucket-padded; slice final_true
 
 
 @dataclass
@@ -94,6 +99,9 @@ class _Group:
     pad_offset: Tuple[int, int]
     device_plan: Optional[TransformPlan]
     members: List[_Pending] = field(default_factory=list)
+    # arbitrary-angle rotate on a shape bucket: per-member geometry rides
+    # in as traced scalars (in_true widens to [h, w, rot_h, rot_w])
+    rotate_dynamic: bool = False
     # aux groups (e.g. batched smart-crop scoring) run this instead of the
     # vmapped transform program: runner(payloads) -> results, one per member
     runner: Optional[callable] = None
@@ -150,39 +158,47 @@ class BatchController:
             or plan.extent is not None
             or plan.extract is not None
         )
+        # arbitrary-angle rotate runs shape-bucketed with traced geometry
+        # (rotate_image_dynamic) UNLESS an extent pad fixed the frame to a
+        # static canvas first — then the static rotate is already shared
+        rotate_dynamic = plan.rotate is not None and layout.pad_canvas is None
+        final_true = final_extent(plan, layout)
         needs_slice = False
         if needs_resample:
             in_shape = (_bucket_dim(h), _bucket_dim(w))
-            if plan.extent is not None or plan.rotate is not None:
-                # crop path: every member lands on the identical extent.
-                # rotate: output geometry is position-sensitive (bucket
-                # padding would rotate garbage into the frame) — keep exact.
+            if plan.extent is not None:
+                # crop/extent path: every member lands on the identical
+                # static extent
                 resample_out = layout.resample_out
             else:
                 # fit path: output height varies with source aspect; bucket
                 # the static output so mixed-aspect members share one
                 # program (the valid region is sliced per member below).
                 # Padding rows replicate the edge row (clamped sampling), so
-                # convolutional post-ops see 'edge' padding — benign.
+                # convolutional post-ops see 'edge' padding — benign; a
+                # dynamic rotate samples only the valid region regardless.
                 resample_out = (
                     _bucket_dim(layout.resample_out[0], 64),
                     _bucket_dim(layout.resample_out[1], 64),
                 )
-                needs_slice = resample_out != layout.resample_out
-        elif plan.rotate is None:
-            # pixel-op-only plans ride input buckets too (edge-replicate
-            # fill in _execute keeps convolutional ops correct); the valid
+                needs_slice = (
+                    rotate_dynamic or resample_out != layout.resample_out
+                )
+        elif plan.rotate is None or rotate_dynamic:
+            # pixel-op-only and rotate plans ride input buckets too
+            # (edge-replicate fill in _execute keeps convolutional ops
+            # correct; dynamic rotate never samples padding). The valid
             # region is sliced per member. Same policy as ops/compose.py.
             in_shape = (_bucket_dim(h), _bucket_dim(w))
             resample_out = None
-            needs_slice = in_shape != (h, w)
+            needs_slice = rotate_dynamic or in_shape != (h, w)
         else:
             in_shape = (h, w)
             resample_out = None
         device_plan = plan.device_plan()
         key = (
             in_shape, resample_out, layout.pad_canvas, layout.pad_offset,
-            device_plan,
+            device_plan, rotate_dynamic,
         )
         future: Future = Future()
         pending = _Pending(
@@ -190,7 +206,7 @@ class BatchController:
             plan=plan,
             future=future,
             enqueued_at=time.monotonic(),
-            out_true=layout.out_true,
+            final_true=final_true,
             needs_slice=needs_slice,
         )
         with self._lock:
@@ -205,6 +221,7 @@ class BatchController:
                     pad_canvas=layout.pad_canvas,
                     pad_offset=layout.pad_offset,
                     device_plan=device_plan,
+                    rotate_dynamic=rotate_dynamic,
                 )
                 self._groups[key] = group
             group.members.append(pending)
@@ -224,7 +241,7 @@ class BatchController:
             plan=None,
             future=future,
             enqueued_at=time.monotonic(),
-            out_true=(0, 0),
+            final_true=(0, 0),
         )
         full_key = ("aux", runner, key)
         with self._lock:
@@ -345,6 +362,7 @@ class BatchController:
             pad_offset=group.pad_offset,
             device_plan=group.device_plan,
             members=take,
+            rotate_dynamic=group.rotate_dynamic,
             runner=group.runner,
         )
         return ready
@@ -388,8 +406,11 @@ class BatchController:
         batch = -(-batch // nd) * nd
         try:
             bh, bw = group.in_shape
+            # dynamic-rotate groups widen in_true with the host-computed
+            # rotated output extent (ops/compose.py make_program_fn)
+            true_w = 4 if group.rotate_dynamic else 2
             images = np.zeros((batch, bh, bw, 3), dtype=np.uint8)
-            in_true = np.zeros((batch, 2), dtype=np.float32)
+            in_true = np.zeros((batch, true_w), dtype=np.float32)
             span_y = np.zeros((batch, 2), dtype=np.float32)
             span_x = np.zeros((batch, 2), dtype=np.float32)
             out_true = np.zeros((batch, 2), dtype=np.float32)
@@ -406,7 +427,9 @@ class BatchController:
                 else:
                     images[i, :h, :w] = member.image
                 layout = plan_layout(member.plan)
-                in_true[i] = (h, w)
+                in_true[i, :2] = (h, w)
+                if group.rotate_dynamic:
+                    in_true[i, 2:] = member.final_true
                 span_y[i] = layout.span_y
                 span_x[i] = layout.span_x
                 out_true[i] = layout.out_true
@@ -425,6 +448,7 @@ class BatchController:
                 group.pad_offset,
                 group.device_plan,
                 self.mesh,
+                group.rotate_dynamic,
             )
             out = np.asarray(
                 fn(
@@ -439,7 +463,7 @@ class BatchController:
             for i, member in enumerate(members):
                 result = out[i]
                 if member.needs_slice:
-                    th, tw = member.out_true
+                    th, tw = member.final_true
                     result = result[: int(th), : int(tw)]
                 member.future.set_result(np.ascontiguousarray(result))
         except Exception as exc:  # pragma: no cover - defensive

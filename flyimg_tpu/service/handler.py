@@ -17,6 +17,7 @@ from __future__ import annotations
 import threading
 import time
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutureTimeout
 from dataclasses import dataclass, field
 from typing import Dict, Optional, Tuple
 
@@ -24,7 +25,7 @@ import numpy as np
 
 from flyimg_tpu.appconfig import AppParameters
 from flyimg_tpu.codecs import decode, encode, media_info
-from flyimg_tpu.exceptions import AppException
+from flyimg_tpu.exceptions import ServiceUnavailableException
 from flyimg_tpu.ops.compose import run_plan
 from flyimg_tpu.service.input_source import load_source
 from flyimg_tpu.service.output_image import OutputSpec, resolve_output
@@ -183,10 +184,6 @@ class ImageHandler:
             # wait for it instead of running a duplicate device pipeline —
             # but never forever: a wedged leader must shed followers as
             # 503s, not strand every coalesced request
-            from concurrent.futures import TimeoutError as FutureTimeout
-
-            from flyimg_tpu.exceptions import ServiceUnavailableException
-
             try:
                 # generous multiple of the per-device-call budget: a slow
                 # but healthy leader (multi-frame GIF, several post-pass
@@ -425,14 +422,16 @@ class ImageHandler:
 
         # rf_1 debug header payload (reference `identify` line via the
         # im-identify header, Response.php:62 + Processor.php:71-77),
-        # rebuilt from our own no-decode probe of the encoded bytes
-        out_info = media_info(content)
-        fmt = spec.extension.upper().replace("JPG", "JPEG")
-        spec.identify_repr = (
-            f"{spec.name} {fmt} {out_info.width}x{out_info.height} "
-            f"{out_info.width}x{out_info.height}+0+0 8-bit sRGB "
-            f"{len(content)}B"
-        )
+        # rebuilt from our own no-decode probe of the encoded bytes —
+        # only on debug requests; only they emit the header
+        if str(options.get("refresh") or "") == "1":
+            out_info = media_info(content)
+            fmt = spec.extension.upper().replace("JPG", "JPEG")
+            spec.identify_repr = (
+                f"{spec.name} {fmt} {out_info.width}x{out_info.height} "
+                f"{out_info.width}x{out_info.height}+0+0 8-bit sRGB "
+                f"{len(content)}B"
+            )
         return content
 
 

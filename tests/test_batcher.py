@@ -247,3 +247,64 @@ def test_aux_and_transform_groups_coexist(controller):
     f_aux = controller.submit_aux(("inc",), 41, runner)
     assert f_aux.result(timeout=120) == 42
     assert f_transform.result(timeout=120).shape == (150, 200, 3)
+
+
+def test_mixed_size_rotate_shares_one_batch():
+    """Two DIFFERENT-sized r_45 requests must land in one group (one
+    compiled executable) and match the single-image path pixel-exactly."""
+    ctl = BatchController(max_batch=2, deadline_ms=10_000.0, lone_flush=False)
+    try:
+        sources = []
+        futures = []
+        for i, (w, h) in enumerate([(300, 200), (260, 180)]):
+            img = make_test_image(w, h, seed=20 + i)
+            plan = _plan("r_45", w, h)
+            sources.append((img, plan))
+            futures.append(ctl.submit(img, plan))
+        outs = [f.result(timeout=120) for f in futures]
+        assert ctl.stats()["batches"] == 1.0  # ONE executable, shared
+        for out, (img, plan) in zip(outs, sources):
+            single = run_plan(img, plan)
+            assert out.shape == single.shape
+            _assert_rotate_parity(out, single)
+    finally:
+        ctl.close()
+
+
+def _assert_rotate_parity(out, single):
+    """Dynamic vs static rotate may differ by 1 uint8 step on a handful of
+    pixels (traced-scalar vs constant-folded centers change XLA's float
+    contraction at round() knife-edges); anything more is a real bug."""
+    diff = np.abs(out.astype(np.int16) - single.astype(np.int16))
+    assert diff.max() <= 1, diff.max()
+    assert (diff != 0).mean() < 1e-4
+
+
+def test_rotate_90_multiples_batch_match_single(controller):
+    for angle in (90, 180, 270):
+        img = make_test_image(250, 170, seed=angle)
+        plan = _plan(f"r_{angle}", 250, 170)
+        out = controller.submit(img, plan).result(timeout=120)
+        np.testing.assert_array_equal(out, run_plan(img, plan))
+
+
+def test_resize_plus_rotate_mixed_sizes_share_batch():
+    """The reference bench scenario shape (r_-45,w_400,h_400) across mixed
+    source sizes: fit-resample buckets + dynamic rotate = one group."""
+    ctl = BatchController(max_batch=2, deadline_ms=10_000.0, lone_flush=False)
+    try:
+        sources = []
+        futures = []
+        for i, (w, h) in enumerate([(640, 480), (600, 400)]):
+            img = make_test_image(w, h, seed=30 + i)
+            plan = _plan("r_-45,w_400,h_400", w, h)
+            sources.append((img, plan))
+            futures.append(ctl.submit(img, plan))
+        outs = [f.result(timeout=120) for f in futures]
+        assert ctl.stats()["batches"] == 1.0
+        for out, (img, plan) in zip(outs, sources):
+            single = run_plan(img, plan)
+            assert out.shape == single.shape
+            _assert_rotate_parity(out, single)
+    finally:
+        ctl.close()
