@@ -52,17 +52,17 @@ def decode(
     *,
     target_hint: Optional[Tuple[int, int]] = None,
     frame: int = 0,
+    info: Optional[MediaInfo] = None,
 ) -> DecodedImage:
     """Decode bytes -> DecodedImage. JPEG/WebP ride the native codec when
     built; everything else (and all alpha/animation handling) uses PIL.
     Alpha sources keep RAW rgb + a separate alpha plane; the handler
-    flattens over the bg_ color only where alpha is actually dropped."""
-    info = media_info(data)
+    flattens over the bg_ color only where alpha is actually dropped.
+    Pass ``info`` when the caller already probed the bytes."""
+    info = info or media_info(data)
     if native_codec.available():
         if info.mime == "image/jpeg":
-            scale_num = 8
-            if target_hint and info.width and info.height:
-                scale_num = _dct_scale_num(info.width, info.height, target_hint)
+            scale_num = jpeg_batch_scale_num(info, target_hint)
             rgb = native_codec.jpeg_decode(data, scale_num)
             if rgb is not None:
                 orientation = jpeg_orientation(data)
@@ -95,6 +95,34 @@ def decode(
                     orig_size=(rgb.shape[1], rgb.shape[0]),
                 )
     return pil_codec.decode(data, target_hint=target_hint, frame=frame)
+
+
+def jpeg_batch_scale_num(data_info: MediaInfo, target_hint) -> int:
+    """The DCT prescale denominator the batch decode path should use for
+    one source (mirrors the single-image native path above)."""
+    if target_hint and data_info.width and data_info.height:
+        return _dct_scale_num(data_info.width, data_info.height, target_hint)
+    return 8
+
+
+def batch_jpeg_decode(items: list) -> list:
+    """Aux-group runner: decode many JPEGs in ONE native pool call — C
+    worker threads run in parallel regardless of Python thread counts.
+    ``items`` are (bytes, scale_num) with a uniform scale (the aux group
+    key carries it); returns oriented RGB arrays (None = fall back to the
+    single-image path)."""
+    pool = native_codec.get_pool()
+    if pool is None:
+        return [None] * len(items)
+    outs = pool.decode_batch([d for d, _ in items], items[0][1])
+    results = []
+    for (data, _), rgb in zip(items, outs):
+        if rgb is None:
+            results.append(None)
+            continue
+        orientation = jpeg_orientation(data)
+        results.append(np.ascontiguousarray(apply_orientation(rgb, orientation)))
+    return results
 
 
 def encode(

@@ -309,3 +309,22 @@ class DecodePool:
             self.close()
         except Exception:
             pass
+
+
+_POOL: Optional[DecodePool] = None
+_POOL_LOCK = threading.Lock()
+
+
+def get_pool() -> Optional[DecodePool]:
+    """Process-wide native decode pool (lazy; None when fastcodec is not
+    built). Serving routes concurrent JPEG cache-misses through it in one
+    batch call — C worker threads decode in parallel regardless of how
+    many Python threads the HTTP layer runs (SURVEY.md section 7 hard
+    part 5)."""
+    global _POOL
+    if not available():
+        return None
+    with _POOL_LOCK:
+        if _POOL is None:
+            _POOL = DecodePool()
+        return _POOL

@@ -159,16 +159,28 @@ class BatchController:
             or plan.extract is not None
         )
         # arbitrary-angle rotate runs shape-bucketed with traced geometry
-        # (rotate_image_dynamic) UNLESS an extent pad fixed the frame to a
-        # static canvas first — then the static rotate is already shared
-        rotate_dynamic = plan.rotate is not None and layout.pad_canvas is None
+        # (rotate_image_dynamic) UNLESS (a) an extent pad fixed the frame
+        # to a static canvas first — the static rotate is already shared —
+        # or (b) a conv op follows the rotate: on a bucketed frame those
+        # would blur the background fill across the valid-region edge,
+        # where the exact-shape path edge-replicates (visible halo)
+        rotate_dynamic = (
+            plan.rotate is not None
+            and layout.pad_canvas is None
+            and plan.blur is None
+            and plan.sharpen is None
+            and plan.unsharp is None
+        )
         final_true = final_extent(plan, layout)
         needs_slice = False
         if needs_resample:
             in_shape = (_bucket_dim(h), _bucket_dim(w))
-            if plan.extent is not None:
+            if plan.extent is not None or (
+                plan.rotate is not None and not rotate_dynamic
+            ):
                 # crop/extent path: every member lands on the identical
-                # static extent
+                # static extent. Static rotate (conv post-ops) keeps the
+                # exact per-aspect output so nothing pads the frame.
                 resample_out = layout.resample_out
             else:
                 # fit path: output height varies with source aspect; bucket
@@ -193,6 +205,7 @@ class BatchController:
             resample_out = None
             needs_slice = rotate_dynamic or in_shape != (h, w)
         else:
+            # static rotate (conv post-ops) without resample: exact frame
             in_shape = (h, w)
             resample_out = None
         device_plan = plan.device_plan()

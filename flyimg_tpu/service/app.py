@@ -135,8 +135,16 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
         metrics=metrics,
         mesh=mesh,
     )
+    # host codec work gets its OWN controller/thread: JPEG-miss decode
+    # batches (native DecodePool) must not serialize with device launches
+    codec_batcher = BatchController(
+        max_batch=int(params.by_key("decode_batch_max", 32)),
+        deadline_ms=float(params.by_key("decode_deadline_ms", 1.0)),
+        metrics=metrics,
+    )
     handler = ImageHandler(
-        storage, params, batcher=batcher, metrics=metrics, sp_mesh=sp_mesh
+        storage, params, batcher=batcher, codec_batcher=codec_batcher,
+        metrics=metrics, sp_mesh=sp_mesh,
     )
 
     @web.middleware
@@ -170,6 +178,7 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
 
     async def _close_batcher(_app):
         batcher.close()
+        codec_batcher.close()
 
     app.on_cleanup.append(_close_batcher)
 

@@ -93,6 +93,7 @@ class ImageHandler:
         params: AppParameters,
         *,
         batcher=None,
+        codec_batcher=None,
         face_backend=None,
         smartcrop_backend=None,
         metrics=None,
@@ -102,6 +103,10 @@ class ImageHandler:
         self.params = params
         self.security = SecurityHandler(params)
         self.batcher = batcher  # BatchController; None = direct device calls
+        # separate controller (own executor thread) for HOST codec work:
+        # concurrent JPEG misses decode as one native-pool batch without
+        # serializing against device launches
+        self.codec_batcher = codec_batcher
         self.metrics = metrics  # runtime.metrics.MetricsRegistry or None
         # multi-device mesh with an 'sp' axis: very large inputs shard
         # H-wise with ppermute halo exchange (parallel/tiling.py — the
@@ -162,7 +167,7 @@ class ImageHandler:
             options, image_src, source.info.mime, accepts_webp=accepts_webp
         )
 
-        refresh = bool(options.get("refresh")) and str(options.get("refresh")) == "1"
+        refresh = options.wants_refresh()
         if refresh and self.storage.has(spec.name):
             self.storage.delete(spec.name)
 
@@ -282,6 +287,36 @@ class ImageHandler:
             jnp.clip(jnp.round(out), 0.0, 255.0).astype(jnp.uint8)
         )
 
+    def _decode_batched(self, data: bytes, hint, info):
+        """JPEG fast path through the native DecodePool: concurrent misses
+        sharing a DCT prescale decode as ONE pool batch on the host-codec
+        controller's thread. Returns None for everything the pool doesn't
+        cover (non-JPEG, pool unavailable, or a per-image decode failure)
+        — the caller falls back to the single-image decode()."""
+        if self.codec_batcher is None:
+            return None
+        from flyimg_tpu.codecs import (
+            DecodedImage,
+            batch_jpeg_decode,
+            jpeg_batch_scale_num,
+        )
+        from flyimg_tpu.codecs import native_codec
+
+        if info.mime != "image/jpeg" or native_codec.get_pool() is None:
+            return None
+        scale = jpeg_batch_scale_num(info, hint)
+        rgb = self.codec_batcher.submit_aux(
+            ("jpegdec", scale), (data, scale), batch_jpeg_decode
+        ).result(timeout=self.DEVICE_RESULT_TIMEOUT_S)
+        if rgb is None:
+            return None
+        return DecodedImage(
+            rgb=rgb,
+            alpha=None,
+            mime="image/jpeg",
+            orig_size=(info.width or rgb.shape[1], info.height or rgb.shape[0]),
+        )
+
     def _process_new(
         self,
         data: bytes,
@@ -298,7 +333,12 @@ class ImageHandler:
         hint = decode_target_hint(options)
 
         gif_frame = options.int_option("gif-frame", 0) or 0
-        decoded = decode(data, target_hint=hint, frame=gif_frame)
+        data_info = media_info(data)  # one probe, shared by both paths
+        decoded = self._decode_batched(data, hint, data_info)
+        if decoded is None:
+            decoded = decode(
+                data, target_hint=hint, frame=gif_frame, info=data_info
+            )
         timings["decode"] = time.perf_counter() - t
 
         w, h = decoded.size
@@ -424,7 +464,7 @@ class ImageHandler:
         # im-identify header, Response.php:62 + Processor.php:71-77),
         # rebuilt from our own no-decode probe of the encoded bytes —
         # only on debug requests; only they emit the header
-        if str(options.get("refresh") or "") == "1":
+        if options.wants_refresh():
             out_info = media_info(content)
             fmt = spec.extension.upper().replace("JPG", "JPEG")
             spec.identify_repr = (

@@ -31,10 +31,7 @@ def image_headers(result: ProcessedImage, header_cache_days: int) -> Dict[str, s
     headers["Content-Type"] = result.spec.mime
     headers["Content-Disposition"] = f'inline;filename="{result.spec.name}"'
 
-    refresh = (
-        bool(result.options.get("refresh"))
-        and str(result.options.get("refresh")) == "1"
-    )
+    refresh = result.options.wants_refresh()
     if refresh:
         headers["Cache-Control"] = "no-cache, private"
         # debug headers (reference Response.php:58-64): the exact device

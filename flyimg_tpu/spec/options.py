@@ -233,6 +233,13 @@ class OptionsBag:
         except (TypeError, ValueError):
             return default
 
+    def wants_refresh(self) -> bool:
+        """The rf_1 debug-refresh predicate — ONE definition for the three
+        consumers (cache bust, identify_repr, debug headers); the reference
+        checks ``$options['refresh'] === true`` after its '1' cast
+        (ImageHandler.php / Response.php)."""
+        return str(self.get("refresh") or "") == "1"
+
     def truthy(self, key: str) -> bool:
         """PHP-style truthiness used all over the reference handler
         (e.g. ``if ($smartCrop && ...)``): '', '0', 0, None, False are falsy —

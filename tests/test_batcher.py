@@ -308,3 +308,13 @@ def test_resize_plus_rotate_mixed_sizes_share_batch():
             _assert_rotate_parity(out, single)
     finally:
         ctl.close()
+
+
+def test_rotate_with_conv_postop_stays_exact(controller):
+    """Conv ops after a rotate opt OUT of the shape-bucketed rotate: on a
+    padded frame the blur would smear background fill across the valid
+    edge. This combo must stay pixel-identical to the single path."""
+    img = make_test_image(300, 200, seed=77)
+    plan = _plan("r_45,blr_2", 300, 200)
+    out = controller.submit(img, plan).result(timeout=120)
+    np.testing.assert_array_equal(out, run_plan(img, plan))

@@ -501,3 +501,55 @@ def test_face_blur_on_alpha_source_flattens_once(env):
     px = np.asarray(out)[40, 40]
     # 50% black over red = (128, 0, 0)
     assert abs(int(px[0]) - 128) <= 2 and px[1] <= 2
+
+
+def test_batched_jpeg_decode_matches_direct(tmp_path):
+    """JPEG misses through the host-codec controller (native DecodePool
+    batch) must produce byte-identical outputs to the single-image decode
+    path, with concurrent decodes coalescing into pool batches."""
+    from flyimg_tpu.codecs import native_codec
+    from flyimg_tpu.runtime.batcher import BatchController
+
+    if native_codec.get_pool() is None:
+        pytest.skip("fastcodec pool not built")
+    import threading
+
+    def make(codec_batcher, tag):
+        params = AppParameters(
+            {
+                "upload_dir": str(tmp_path / f"u-{tag}"),
+                "tmp_dir": str(tmp_path / f"t-{tag}"),
+            }
+        )
+        storage = make_storage(params)
+        return ImageHandler(storage, params, codec_batcher=codec_batcher)
+
+    sources = [
+        _write_jpg(tmp_path / f"j{i}.jpg", w=400 + 8 * i, h=300) for i in range(4)
+    ]
+    direct = make(None, "d")
+    expected = [direct.process_image("w_200,o_png", s).content for s in sources]
+
+    codec_batcher = BatchController(
+        max_batch=8, deadline_ms=25.0, lone_flush=False
+    )
+    try:
+        handler = make(codec_batcher, "b")
+        results = [None] * len(sources)
+
+        def run(i):
+            results[i] = handler.process_image("w_200,o_png", sources[i]).content
+
+        threads = [
+            threading.Thread(target=run, args=(i,)) for i in range(len(sources))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert results == expected
+        summary = codec_batcher.metrics.summary()
+        assert summary.get("flyimg_aux_items_total") == 4.0
+        assert summary.get("flyimg_aux_batches_total") < 4.0
+    finally:
+        codec_batcher.close()
