@@ -119,16 +119,23 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
 
     metrics = MetricsRegistry()
     # with more than one chip, shard every batch over a data-parallel mesh
-    # (SPMD fan-out — the v4-8 serving story; parallel/mesh.py)
+    # (SPMD fan-out — the v4-8 serving story; parallel/mesh.py). Serving
+    # meshes span LOCAL devices only: each pod host runs its own batcher
+    # over its own chips (share-nothing across hosts, like the reference's
+    # scale-out story) — a global mesh would need every host to launch the
+    # same SPMD program in lockstep and would reject device_put of
+    # host-local request pixels as non-addressable. Global meshes remain
+    # the training/offline story (parallel/dist.py, __graft_entry__).
     mesh = None
     sp_mesh = None
     import jax
 
-    if len(jax.devices()) > 1:
+    local_devices = jax.local_devices()
+    if len(local_devices) > 1:
         from flyimg_tpu.parallel.mesh import make_mesh
 
-        mesh = make_mesh()
-        sp_mesh = make_mesh(axis_names=("sp",))
+        mesh = make_mesh(devices=local_devices)
+        sp_mesh = make_mesh(axis_names=("sp",), devices=local_devices)
     batcher = BatchController(
         max_batch=int(params.by_key("batch_max_size", 64)),
         deadline_ms=float(params.by_key("batch_deadline_ms", 4.0)),

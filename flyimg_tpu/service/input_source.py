@@ -116,7 +116,7 @@ def load_source(
     rasterized page at pg_/dnst_. Frames/pages are cached per parameter,
     matching the reference's `<src>-<time>` frame cache
     (VideoProcessor.php:28-33)."""
-    refresh = bool(options.get("refresh")) and str(options.get("refresh")) == "1"
+    refresh = options.wants_refresh()
     cache_path = fetch_original(
         image_url, tmp_dir, refresh=refresh,
         header_extra_options=header_extra_options,

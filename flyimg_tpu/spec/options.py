@@ -234,10 +234,10 @@ class OptionsBag:
             return default
 
     def wants_refresh(self) -> bool:
-        """The rf_1 debug-refresh predicate — ONE definition for the three
-        consumers (cache bust, identify_repr, debug headers); the reference
-        checks ``$options['refresh'] === true`` after its '1' cast
-        (ImageHandler.php / Response.php)."""
+        """The rf_1 debug-refresh predicate — ONE definition for all
+        consumers (source-fetch bust, output-cache bust, identify_repr,
+        debug headers); the reference checks ``$options['refresh'] ===
+        true`` after its '1' cast (ImageHandler.php / Response.php)."""
         return str(self.get("refresh") or "") == "1"
 
     def truthy(self, key: str) -> bool:

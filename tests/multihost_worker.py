@@ -1,0 +1,70 @@
+"""One process of the 2-process multi-host serving test (see
+test_multihost.py). Initializes jax.distributed over a local TCP
+coordinator, builds the PER-HOST serving stack exactly the way make_app
+does (local-devices mesh + BatchController), processes one request, and
+prints a machine-checkable line.
+
+Run: python multihost_worker.py <coordinator> <num_processes> <process_id>
+"""
+
+import os
+import sys
+
+
+def main() -> int:
+    coordinator, num_processes, process_id = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    )
+    # 4 virtual CPU devices per process -> an 8-device global view, of
+    # which only 4 are addressable here (the pod topology in miniature)
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        .replace("--xla_force_host_platform_device_count=8", "")
+        + " --xla_force_host_platform_device_count=4"
+    ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from flyimg_tpu.parallel.dist import initialize_multihost
+
+    assert initialize_multihost(coordinator, num_processes, process_id)
+    assert jax.process_count() == num_processes, jax.process_count()
+    n_global = len(jax.devices())
+    n_local = len(jax.local_devices())
+    assert n_global == 4 * num_processes, n_global
+    assert n_local == 4, n_local
+
+    # the per-host serving stack, as make_app wires it
+    import numpy as np
+
+    from flyimg_tpu.parallel.mesh import make_mesh
+    from flyimg_tpu.runtime.batcher import BatchController
+    from flyimg_tpu.spec.options import OptionsBag
+    from flyimg_tpu.spec.plan import build_plan
+
+    mesh = make_mesh(devices=jax.local_devices())
+    batcher = BatchController(max_batch=8, deadline_ms=2.0, mesh=mesh)
+    try:
+        rng = np.random.default_rng(100 + process_id)
+        img = rng.integers(0, 256, (96, 128, 3), dtype=np.uint8)
+        plan = build_plan(OptionsBag("w_64,h_48,c_1"), 128, 96)
+        out = batcher.submit(img, plan).result(timeout=120)
+        assert out.shape == (48, 64, 3), out.shape
+
+        from flyimg_tpu.ops.compose import run_plan
+
+        np.testing.assert_array_equal(out, run_plan(img, plan))
+    finally:
+        batcher.close()
+    print(
+        f"MULTIHOST_OK process={process_id}/{num_processes} "
+        f"local={n_local} global={n_global}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
