@@ -147,6 +147,15 @@ def encode(
             return blob
     if native_codec.available() and alpha is None:
         if fmt in ("jpg", "jpeg"):
+            if mozjpeg:
+                # moz_1 (default): trellis quantization + optimized Huffman
+                # + progressive — the cjpeg technique set
+                blob = native_codec.jpeg_encode_trellis(
+                    image, quality,
+                    subsampling_444=(sampling_factor == "1x1"),
+                )
+                if blob is not None:
+                    return blob
             blob = native_codec.jpeg_encode(
                 image,
                 quality,

@@ -70,6 +70,12 @@ def _load():
             ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.POINTER(ctypes.c_size_t),
         ]
+        lib.fc_jpeg_encode_trellis.restype = ctypes.c_void_p
+        lib.fc_jpeg_encode_trellis.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
         lib.fc_png_decode.restype = ctypes.c_void_p
         lib.fc_png_decode.argtypes = [
             ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int,
@@ -152,6 +158,34 @@ def jpeg_encode(
     ptr = lib.fc_jpeg_encode(
         rgb.tobytes(), w, h, int(quality), int(optimize), int(progressive),
         0 if subsampling_444 else 2, ctypes.byref(out_len),
+    )
+    if not ptr:
+        return None
+    arr = _take_buffer(lib, ptr, out_len.value)
+    return arr.tobytes()
+
+
+def jpeg_encode_trellis(
+    rgb: np.ndarray,
+    quality: int = 90,
+    *,
+    progressive: bool = True,
+    subsampling_444: bool = True,
+) -> Optional[bytes]:
+    """MozJPEG-technique encode: trellis-quantized coefficients + optimized
+    Huffman + progressive scans (fc_jpeg_encode_trellis). ~5-10% smaller
+    than the plain optimized encoder at ~equal PSNR on photographic
+    content."""
+    lib = _load()
+    if not lib:
+        return None
+    rgb = np.ascontiguousarray(rgb, dtype=np.uint8)
+    h, w = rgb.shape[:2]
+    out_len = ctypes.c_size_t()
+    ptr = lib.fc_jpeg_encode_trellis(
+        rgb.tobytes(), w, h, int(quality),
+        0 if subsampling_444 else 2, int(progressive),
+        ctypes.byref(out_len),
     )
     if not ptr:
         return None

@@ -106,9 +106,10 @@ def encode(
 
     fmt in {'jpg', 'png', 'webp', 'gif'} — the reference's allowed outputs
     (src/Core/Entity/Image/OutputImage.php:41). ``mozjpeg`` selects the
-    high-ratio JPEG path: progressive + optimized Huffman tables, the two
-    headline MozJPEG techniques (reference pipes through cjpeg,
-    ImageProcessor.php:204-209).
+    high-ratio JPEG path: here (the PIL fallback) that is progressive +
+    optimized Huffman only; the native path adds trellis quantization for
+    the full cjpeg technique set (reference pipes through cjpeg,
+    ImageProcessor.php:204-209; fastcodec.cpp fc_jpeg_encode_trellis).
     """
     quality = max(0, min(int(quality), 100))
     pil = Image.fromarray(image)

@@ -145,26 +145,26 @@ def _forward(params, images, score_threshold: float = 0.5):
     return probs, boxes
 
 
-def detect_faces(
-    params,
-    rgb: np.ndarray,
-    *,
-    score_threshold: float = 0.5,
-    max_faces: int = 16,
-) -> List[Tuple[int, int, int, int]]:
-    """[h, w, 3] uint8 -> list of (x, y, w, h) pixel boxes. Same contract as
-    facefind.detect_faces so the handler can swap backends."""
+def _network_input(rgb: np.ndarray) -> np.ndarray:
     from PIL import Image
 
-    src_h, src_w = rgb.shape[:2]
     resized = np.asarray(
         Image.fromarray(rgb).resize((INPUT_SIZE, INPUT_SIZE), Image.BILINEAR),
         dtype=np.float32,
     )
-    inp = (resized / 127.5 - 1.0)[None]
-    probs, boxes = _forward(params, jnp.asarray(inp))
-    probs = np.asarray(probs[0])
-    boxes = np.asarray(boxes[0])
+    return resized / 127.5 - 1.0
+
+
+def _boxes_from_scores(
+    probs: np.ndarray,
+    boxes: np.ndarray,
+    src_w: int,
+    src_h: int,
+    score_threshold: float,
+    max_faces: int,
+) -> List[Tuple[int, int, int, int]]:
+    """Greedy NMS over decoded anchors -> pixel boxes (shared by the
+    single-image and batched entry points)."""
     keep = np.argsort(-probs)[: max_faces * 4]
     out: List[Tuple[int, int, int, int]] = []
     taken: List[Tuple[float, float, float, float]] = []
@@ -183,6 +183,51 @@ def detect_faces(
         if x1 > x0 and y1 > y0:
             out.append((x0, y0, x1 - x0, y1 - y0))
     return out
+
+
+def detect_faces(
+    params,
+    rgb: np.ndarray,
+    *,
+    score_threshold: float = 0.5,
+    max_faces: int = 16,
+) -> List[Tuple[int, int, int, int]]:
+    """[h, w, 3] uint8 -> list of (x, y, w, h) pixel boxes. Same contract as
+    facefind.detect_faces so the handler can swap backends."""
+    return detect_faces_batch(
+        params, [rgb], score_threshold=score_threshold, max_faces=max_faces
+    )[0]
+
+
+def detect_faces_batch(
+    params,
+    rgbs: List[np.ndarray],
+    *,
+    score_threshold: float = 0.5,
+    max_faces: int = 16,
+) -> List[List[Tuple[int, int, int, int]]]:
+    """Many images -> boxes in ONE batched forward: the fixed 128x128
+    network input means every request shares a single compiled program
+    (batch axis rides the power-of-two ladder)."""
+    from flyimg_tpu.ops.compose import bucket_batch
+
+    n = len(rgbs)
+    if n == 0:
+        return []
+    nb = bucket_batch(n)
+    inputs = np.zeros((nb, INPUT_SIZE, INPUT_SIZE, 3), np.float32)
+    for i, rgb in enumerate(rgbs):
+        inputs[i] = _network_input(rgb)
+    probs, boxes = _forward(params, jnp.asarray(inputs))
+    probs = np.asarray(probs)
+    boxes = np.asarray(boxes)
+    return [
+        _boxes_from_scores(
+            probs[i], boxes[i], rgbs[i].shape[1], rgbs[i].shape[0],
+            score_threshold, max_faces,
+        )
+        for i in range(n)
+    ]
 
 
 def _iou(a, b) -> float:

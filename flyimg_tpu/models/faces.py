@@ -1,0 +1,139 @@
+"""Face backend registry: one detect/blur/crop contract, three engines.
+
+The reference has exactly one face engine — a shell-out to `facedetect`
+(OpenCV Haar cascades; FaceDetectProcessor.php:27-29). This framework
+keeps the same list-of-boxes contract behind a pluggable backend chosen
+by the ``face_backend`` / ``face_checkpoint`` app parameters:
+
+- ``haar``   — the reference's detector family, evaluated in-process from
+  the same cascade XML files (models/haar.py). Real face detection with
+  zero learned state of our own; the parity default where cascades exist.
+- ``blazeface`` — the TPU-native north star (models/blazeface.py): a
+  BlazeFace convnet served batched through the runtime; needs a trained
+  checkpoint (one is packaged; ``face_checkpoint`` overrides).
+- ``facefind`` — the dependency-free classical skin-blob proposer
+  (models/facefind.py); the fallback when neither is available.
+
+Blur (pixelation) and crop are shared device-side ops regardless of the
+detector (facefind.blur_faces / crop_face wrap ops/pixelate.py).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from flyimg_tpu.models import facefind
+
+Box = Tuple[int, int, int, int]
+
+PACKAGED_BLAZEFACE = os.path.join(
+    os.path.dirname(__file__), "weights", "blazeface"
+)
+
+
+class HaarBackend:
+    """In-process Haar cascade detection (reference parity backend)."""
+
+    def __init__(
+        self,
+        cascade_path: Optional[str] = None,
+        *,
+        min_neighbors: int = 2,
+    ) -> None:
+        from flyimg_tpu.models import haar
+
+        self._haar = haar
+        self.cascade_path = cascade_path or haar.find_cascade()
+        if self.cascade_path is None:
+            raise RuntimeError("no haar cascade XML available")
+        self.min_neighbors = min_neighbors
+
+    def detect_faces(self, rgb: np.ndarray) -> List[Box]:
+        return self._haar.detect_faces(
+            rgb,
+            cascade_path=self.cascade_path,
+            min_neighbors=self.min_neighbors,
+        )
+
+    blur_faces = staticmethod(facefind.blur_faces)
+    crop_face = staticmethod(facefind.crop_face)
+
+
+class BlazeFaceBackend:
+    """BlazeFace convnet detection; fixed 128x128 input makes batched
+    serving trivial (one jitted program, period)."""
+
+    def __init__(self, checkpoint: str, *, score_threshold: float = 0.5) -> None:
+        from flyimg_tpu.models import blazeface
+
+        self._bf = blazeface
+        self.params = blazeface.load_checkpoint(checkpoint)
+        self.score_threshold = score_threshold
+
+    def detect_faces(self, rgb: np.ndarray) -> List[Box]:
+        return self._bf.detect_faces(
+            self.params, rgb, score_threshold=self.score_threshold
+        )
+
+    # batched serving path (handler submits via the aux batcher): payloads
+    # are full images; the runner resizes + runs ONE batched forward
+    def prepare_face_work(self, rgb: np.ndarray, threshold: float = 0.0):
+        del threshold
+        return facefind.FaceWork(
+            image=np.ascontiguousarray(rgb),
+            threshold=self.score_threshold,
+            # fixed network input -> every request shares one bucket/key
+            bucket=(self._bf.INPUT_SIZE, self._bf.INPUT_SIZE),
+        )
+
+    def detect_faces_batched(self, items) -> List[List[Box]]:
+        return self._bf.detect_faces_batch(
+            self.params,
+            [item.image for item in items],
+            score_threshold=self.score_threshold,
+        )
+
+    blur_faces = staticmethod(facefind.blur_faces)
+    crop_face = staticmethod(facefind.crop_face)
+
+
+class FacefindBackend:
+    """Classical skin-blob proposer (no external data requirements)."""
+
+    detect_faces = staticmethod(facefind.detect_faces)
+    prepare_face_work = staticmethod(facefind.prepare_face_work)
+    detect_faces_batched = staticmethod(facefind.detect_faces_batched)
+    blur_faces = staticmethod(facefind.blur_faces)
+    crop_face = staticmethod(facefind.crop_face)
+
+
+def make_face_backend(
+    name: str = "auto", checkpoint: Optional[str] = None
+):
+    """Resolve the serving face backend. ``auto`` prefers the reference's
+    own detector family (haar) where cascade files exist, falling back to
+    the skin-blob proposer; ``blazeface`` uses ``checkpoint`` or the
+    packaged weights."""
+    name = (name or "auto").lower()
+    if name == "blazeface":
+        ckpt = checkpoint or PACKAGED_BLAZEFACE
+        if not os.path.exists(ckpt):
+            raise RuntimeError(
+                f"blazeface checkpoint not found at {ckpt}; set "
+                "face_checkpoint or train one with tools/train_blazeface.py"
+            )
+        return BlazeFaceBackend(ckpt)
+    if name == "haar":
+        return HaarBackend(checkpoint)
+    if name == "facefind":
+        return FacefindBackend()
+    if name == "auto":
+        from flyimg_tpu.models import haar
+
+        if haar.available():
+            return HaarBackend()
+        return FacefindBackend()
+    raise ValueError(f"unknown face_backend {name!r}")

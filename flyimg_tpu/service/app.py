@@ -149,9 +149,17 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
         deadline_ms=float(params.by_key("decode_deadline_ms", 1.0)),
         metrics=metrics,
     )
+    # face engine: 'auto' (haar where cascade XMLs exist, else the skin
+    # proposer), 'haar', 'blazeface' (+ face_checkpoint), or 'facefind'
+    from flyimg_tpu.models.faces import make_face_backend
+
+    face_backend = make_face_backend(
+        str(params.by_key("face_backend", "auto")),
+        params.by_key("face_checkpoint"),
+    )
     handler = ImageHandler(
         storage, params, batcher=batcher, codec_batcher=codec_batcher,
-        metrics=metrics, sp_mesh=sp_mesh,
+        face_backend=face_backend, metrics=metrics, sp_mesh=sp_mesh,
     )
 
     @web.middleware

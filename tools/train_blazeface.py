@@ -1,0 +1,211 @@
+"""Train the BlazeFace backend checkpoint: synthetic ellipses + REAL faces.
+
+Real-face supervision is harvested automatically: any photos found in
+``--photos`` directories are run through the Haar cascade detector
+(models/haar.py — the reference's own detector family), and the detected
+face crops become training material, pasted with heavy augmentation
+(scale / position / flip / brightness / background swaps) onto 128x128
+canvases built from noise, flat color, and non-face crops of the same
+photos. Synthetic ellipse faces (models/blazeface.synthetic_batch's
+recipe) are mixed in so the detector keeps working when no photos are
+available at training time.
+
+The resulting checkpoint is packaged at models/weights/blazeface and is
+what ``face_backend: blazeface`` serves by default.
+
+Usage:
+    python tools/train_blazeface.py --steps 800 --out flyimg_tpu/models/weights/blazeface
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_PHOTO_DIRS = [
+    # reference test fixtures (read-only; never copied into this repo)
+    "/root/reference/tests/testImages",
+    "/root/reference/web",
+]
+
+
+def harvest_faces(photo_dirs):
+    """(face_crops, background_images) from whatever photos exist."""
+    from PIL import Image
+
+    from flyimg_tpu.models import haar
+
+    faces, backgrounds = [], []
+    if not haar.available():
+        return faces, backgrounds
+    paths = []
+    for d in photo_dirs:
+        paths += sorted(
+            glob.glob(os.path.join(d, "*.jpg"))
+            + glob.glob(os.path.join(d, "*.png"))
+        )
+    for path in paths:
+        try:
+            img = np.asarray(Image.open(path).convert("RGB"))
+        except Exception:
+            continue
+        if min(img.shape[:2]) < 64:
+            continue
+        boxes = haar.detect_faces(img)
+        backgrounds.append(img)
+        for x, y, w, h in boxes:
+            # generous margin so augmentation can crop tighter/looser
+            m = int(0.35 * max(w, h))
+            y0, y1 = max(y - m, 0), min(y + h + m, img.shape[0])
+            x0, x1 = max(x - m, 0), min(x + w + m, img.shape[1])
+            crop = img[y0:y1, x0:x1]
+            if min(crop.shape[:2]) >= 24:
+                # face box RELATIVE to the crop (for target geometry)
+                faces.append((crop, (x - x0, y - y0, w, h)))
+    return faces, backgrounds
+
+
+def _canvas(rng, backgrounds, size):
+    kind = rng.integers(0, 3 if backgrounds else 2)
+    if kind == 0:
+        return rng.integers(0, 256, (size, size, 3)).astype(np.uint8)
+    if kind == 1:
+        return np.full((size, size, 3), rng.integers(0, 256, 3), np.uint8)
+    from PIL import Image
+
+    bg = backgrounds[rng.integers(0, len(backgrounds))]
+    h, w = bg.shape[:2]
+    # crop side clamped to what the photo has (some backgrounds are
+    # smaller than the canvas; the resize below upscales those)
+    s = rng.integers(min(size, min(h, w)), min(h, w) + 1)
+    y = rng.integers(0, h - s + 1)
+    x = rng.integers(0, w - s + 1)
+    return np.asarray(
+        Image.fromarray(bg[y : y + s, x : x + s]).resize((size, size))
+    )
+
+
+def real_batch(rng, batch, faces, backgrounds):
+    """Augmented real-face batch with the same anchor-target scheme as
+    blazeface.synthetic_batch."""
+    from PIL import Image
+
+    from flyimg_tpu.models import blazeface as bf
+
+    size = bf.INPUT_SIZE
+    anchors = np.asarray(bf.anchor_centers())
+    images = np.zeros((batch, size, size, 3), np.float32)
+    target_probs = np.zeros((batch, bf.NUM_ANCHORS), np.float32)
+    target_boxes = np.zeros((batch, bf.NUM_ANCHORS, 4), np.float32)
+    mask = np.zeros((batch, bf.NUM_ANCHORS), np.float32)
+    for i in range(batch):
+        canvas = _canvas(rng, backgrounds, size).astype(np.float32)
+        n_faces = rng.integers(0, 3)  # 0..2 faces (negatives matter)
+        for _ in range(n_faces):
+            crop, (fx, fy, fw, fh) = faces[rng.integers(0, len(faces))]
+            # paste scale: face occupies 15-55% of the canvas
+            face_frac = rng.uniform(0.15, 0.55)
+            scale = face_frac * size / max(fw, fh)
+            ch, cw = crop.shape[:2]
+            sw, sh = max(int(cw * scale), 8), max(int(ch * scale), 8)
+            pil = Image.fromarray(crop.astype(np.uint8)).resize((sw, sh))
+            patch = np.asarray(pil, np.float32)
+            if rng.random() < 0.5:
+                patch = patch[:, ::-1]
+                fx = cw - fx - fw
+            patch = np.clip(
+                patch * rng.uniform(0.6, 1.4) + rng.uniform(-30, 30), 0, 255
+            )
+            px = rng.integers(-sw // 4, size - sw + sw // 4 + 1)
+            py = rng.integers(-sh // 4, size - sh + sh // 4 + 1)
+            # visible region
+            vx0, vy0 = max(px, 0), max(py, 0)
+            vx1, vy1 = min(px + sw, size), min(py + sh, size)
+            if vx1 <= vx0 or vy1 <= vy0:
+                continue
+            canvas[vy0:vy1, vx0:vx1] = patch[
+                vy0 - py : vy1 - py, vx0 - px : vx1 - px
+            ]
+            # face box in canvas coords, normalized
+            bx = (px + fx * scale) / size
+            by = (py + fy * scale) / size
+            bs = max(fw, fh) * scale / size
+            cx, cy = bx + fw * scale / size / 2, by + fh * scale / size / 2
+            if not (0.05 < cx < 0.95 and 0.05 < cy < 0.95):
+                continue
+            dist = np.abs(anchors[:, 0] - cx) + np.abs(anchors[:, 1] - cy)
+            pos = np.argsort(dist)[:8]
+            target_probs[i, pos] = 1.0
+            mask[i, pos] = 1.0
+            target_boxes[i, pos, 0] = (cx - anchors[pos, 0]) / (0.1 * anchors[pos, 2])
+            target_boxes[i, pos, 1] = (cy - anchors[pos, 1]) / (0.1 * anchors[pos, 3])
+            target_boxes[i, pos, 2] = np.log(max(bs, 1e-3) / anchors[pos, 2]) / 0.2
+            target_boxes[i, pos, 3] = np.log(max(bs, 1e-3) / anchors[pos, 3]) / 0.2
+        images[i] = canvas / 127.5 - 1.0
+    return images, target_probs, target_boxes, mask
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=800)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--real-fraction", type=float, default=0.7)
+    ap.add_argument("--photos", action="append", default=None)
+    ap.add_argument(
+        "--out", default="flyimg_tpu/models/weights/blazeface"
+    )
+    ap.add_argument("--log-every", type=int, default=50)
+    ap.add_argument(
+        "--platform", default=None,
+        help="force a jax platform (e.g. 'cpu' — needed in environments "
+             "whose sitecustomize pins a TPU backend)",
+    )
+    args = ap.parse_args()
+
+    if args.platform == "cpu":
+        from flyimg_tpu.parallel.mesh import force_cpu_platform
+
+        force_cpu_platform(1)
+
+    import jax
+    import jax.numpy as jnp
+
+    from flyimg_tpu.models import blazeface as bf
+
+    rng = np.random.default_rng(args.seed)
+    faces, backgrounds = harvest_faces(args.photos or DEFAULT_PHOTO_DIRS)
+    print(f"harvested {len(faces)} real face crops, "
+          f"{len(backgrounds)} background photos")
+
+    params = bf.init_params(jax.random.PRNGKey(args.seed))
+    optimizer, train_step = bf.make_train_step()
+    opt_state = optimizer.init(params)
+    step_fn = jax.jit(train_step, donate_argnums=(0, 1))
+
+    for step in range(args.steps):
+        use_real = faces and rng.random() < args.real_fraction
+        if use_real:
+            batch = real_batch(rng, args.batch, faces, backgrounds)
+        else:
+            batch = bf.synthetic_batch(rng, args.batch)
+        params, opt_state, loss = step_fn(
+            params, opt_state, *(jnp.asarray(x) for x in batch)
+        )
+        if args.log_every and step % args.log_every == 0:
+            src = "real" if use_real else "synth"
+            print(f"step {step}: loss {float(loss):.4f} ({src})")
+
+    bf.save_checkpoint(params, args.out)
+    print(f"saved checkpoint to {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
