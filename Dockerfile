@@ -18,9 +18,11 @@ RUN make -C flyimg_tpu/codecs/native
 FROM python:3.12-slim
 
 # ghostscript: the PDF rasterizer (reference Dockerfile:5 — pg_/dnst_
-# options 415 without it); ffmpeg: the video frame-extraction fallback
+# options 415 without it); ffmpeg: the video frame-extraction fallback;
+# opencv-data: the Haar cascade XMLs the face backend evaluates
+# (models/haar.py — the reference facedetect's model files)
 RUN apt-get update && apt-get install -y --no-install-recommends \
-        libjpeg62-turbo libpng16-16 libwebp7 ghostscript ffmpeg \
+        libjpeg62-turbo libpng16-16 libwebp7 ghostscript ffmpeg opencv-data \
     && rm -rf /var/lib/apt/lists/*
 
 WORKDIR /app

@@ -49,14 +49,50 @@ def tiled_transform(
     method: str = "lanczos3",
 ) -> jnp.ndarray:
     """Resize [H, W, 3] -> [out_h, out_w, 3] with H sharded over
-    ``mesh[axis]``. H and out_h must divide the axis size.
+    ``mesh[axis]``. Heights that don't divide the axis size are padded to
+    it (edge-replicated input rows, garbage output rows sliced off), so
+    ANY tall image rides the firehose path, not just divisible ones.
 
     Programs are cached by (geometry, mesh, method) — serving hot paths
     (handler._tiled_or_none) re-trace nothing for a repeated geometry.
     """
+    n = int(mesh.shape[axis])
     in_h, in_w = int(image.shape[0]), int(image.shape[1])
-    fn = _build_tiled_program(in_h, in_w, tuple(out_hw), mesh, axis, method)
-    return fn(image.astype(jnp.float32))
+    out_h, out_w = int(out_hw[0]), int(out_hw[1])
+    pad_in = (-in_h) % n
+    pad_out = (-out_h) % n
+    if required_halo(in_h + pad_in, out_h + pad_out, in_h, out_h, n) > (
+        (in_h + pad_in) // n
+    ):
+        # extreme downscales of short-ish tiles would need more neighbor
+        # rows than a tile holds; clamping would silently corrupt pixels
+        raise ValueError(
+            f"tiled resample infeasible: halo exceeds tile height for "
+            f"{in_h}->{out_h} over {n} devices"
+        )
+    # pad rows only so the shard splits evenly — the kernel's bottom_valid
+    # mask zeroes their weights, so the replicated values never matter
+    x = image.astype(jnp.float32)
+    if pad_in:
+        x = jnp.pad(x, ((0, pad_in), (0, 0), (0, 0)), mode="edge")
+    fn = _build_tiled_program(
+        in_h + pad_in, in_w, (out_h + pad_out, out_w), mesh, axis, method,
+        true_in_h=in_h, true_out_h=out_h,
+    )
+    out = fn(x)
+    return out[:out_h] if pad_out else out
+
+
+def required_halo(
+    in_h_pad: int, out_h_pad: int, src_h: int, dst_h: int, n: int
+) -> int:
+    """Neighbor rows each tile needs: kernel support at the true scale plus
+    the cumulative drift between the padded tile grid and the true span
+    (device idx's outputs start at idx*out_tile_h*row_scale but its tile
+    starts at idx*tile_h)."""
+    scale_y = max(src_h / dst_h, 1.0)
+    drift = (out_h_pad // n) * (src_h / dst_h) - in_h_pad // n
+    return int(3.0 * scale_y + 2.0 + abs(drift) * (n - 1)) + 1
 
 
 @lru_cache(maxsize=128)
@@ -67,6 +103,9 @@ def _build_tiled_program(
     mesh: Mesh,
     axis: str,
     method: str,
+    *,
+    true_in_h: int = None,
+    true_out_h: int = None,
 ):
     """Jitted shard_map program for one tiled-resample geometry.
 
@@ -74,16 +113,28 @@ def _build_tiled_program(
     and the height axis from (local tile + halos) with a weight matrix whose
     sample coordinates are offset by the device's global tile position —
     ppermute is the only cross-device communication.
+
+    ``true_in_h``/``true_out_h`` carry the unpadded geometry when the
+    sharded dims were rounded up to the axis size: sampling coordinates
+    derive from the TRUE scale, rows at/past true_in_h are masked out of
+    the weights (clamp-to-edge semantics, matching ops/resample.py), and
+    output rows past true_out_h are garbage the caller slices off.
     """
     n = mesh.shape[axis]
     out_h, out_w = out_hw
     if in_h % n or out_h % n:
         raise ValueError(f"H={in_h} and out_h={out_h} must divide mesh axis {n}")
+    src_h = true_in_h if true_in_h is not None else in_h
+    dst_h = true_out_h if true_out_h is not None else out_h
     tile_h = in_h // n
     out_tile_h = out_h // n
-    # source rows any output row needs: kernel support * downscale ratio
-    scale_y = max(in_h / out_h, 1.0)
-    halo = min(int(3.0 * scale_y) + 2, tile_h)
+    # neighbor rows each tile needs (callers pre-check feasibility; the
+    # assert is the safety net against silent pixel corruption). Programs
+    # compile per (in_h_pad, out) geometry — tall-image traffic clusters
+    # on a handful of camera/pipeline geometries (the firehose config is
+    # ONE), matching the pre-padding behavior for divisible heights.
+    halo = required_halo(in_h, out_h, src_h, dst_h, n)
+    assert halo <= tile_h, (halo, tile_h)
 
     def kernel(tile):  # [tile_h, W, 3] on each device
         idx = jax.lax.axis_index(axis)
@@ -91,17 +142,22 @@ def _build_tiled_program(
         local_rows = tile_h + 2 * halo
         # global source span of MY output rows, expressed in local coords:
         # out row r (global r0 = idx*out_tile_h) samples global source
-        # y = (r + .5) * in_h/out_h - .5; local y = y - (idx*tile_h - halo)
-        row_scale = in_h / out_h
+        # y = (r + .5) * src_h/dst_h - .5; local y = y - (idx*tile_h - halo)
+        row_scale = src_h / dst_h
         global_start = idx * out_tile_h * row_scale
         local_offset = idx * tile_h - halo
         span_start = global_start - local_offset
         span_size = out_tile_h * row_scale
         # valid local rows: [halo, halo+tile_h) plus real halo rows where the
-        # neighbor exists; weight masking uses in_true rows from the top
+        # neighbor exists; weight masking uses in_true rows from the top.
+        # Rows at/past the TRUE source height (bucket padding) are invalid
+        # everywhere — the min() folds both limits into one clamp.
         top_valid = jnp.where(idx == 0, halo, 0)
         bottom_valid = jnp.where(
             idx == jax.lax.axis_size(axis) - 1, local_rows - halo, local_rows
+        )
+        bottom_valid = jnp.minimum(
+            bottom_valid, jnp.float32(src_h) - local_offset
         )
         wy = resample_matrix(
             local_rows, out_tile_h,

@@ -252,18 +252,17 @@ class ImageHandler:
         if plan.device_plan() != bare:
             return None
         h, w = frame.shape[:2]
-        n = int(self.sp_mesh.shape["sp"])
-        if h < self.TILE_MIN_ROWS or h % n:
+        if h < self.TILE_MIN_ROWS:
             return None
         from flyimg_tpu.ops.compose import plan_layout
 
         # layout geometry checks cover crop windows / extent pads / extract
-        # offsets in one generalizing form (span must be the full frame)
+        # offsets in one generalizing form (span must be the full frame);
+        # heights need NOT divide the sp axis — tiled_transform pads
         layout = plan_layout(plan)
         out_h, out_w = layout.resample_out
         if (
-            out_h % n
-            or layout.out_true != (out_h, out_w)
+            layout.out_true != (out_h, out_w)
             or layout.pad_canvas is not None
             or layout.span_y != (0.0, float(h))
             or layout.span_x != (0.0, float(w))
@@ -274,10 +273,14 @@ class ImageHandler:
 
         from flyimg_tpu.parallel.tiling import tiled_transform
 
-        out = tiled_transform(
-            jnp.asarray(frame), (out_h, out_w), self.sp_mesh,
-            method=plan.filter_method,
-        )
+        try:
+            out = tiled_transform(
+                jnp.asarray(frame), (out_h, out_w), self.sp_mesh,
+                method=plan.filter_method,
+            )
+        except ValueError:
+            # infeasible geometry (halo would exceed a tile) -> batcher
+            return None
         if self.metrics is not None:
             self.metrics.counter(
                 "flyimg_tiled_resamples_total",

@@ -555,3 +555,29 @@ def test_batched_jpeg_decode_matches_direct(tmp_path):
         assert summary.get("flyimg_aux_batches_total") == 1.0
     finally:
         codec_batcher.close()
+
+
+def test_tiled_firehose_accepts_indivisible_height(tmp_path):
+    """A 2161-row 4k-ish input must ride the sp-tiling firehose path even
+    though 2161 doesn't divide the mesh axis (pad-to-divisible)."""
+    from flyimg_tpu.parallel.mesh import make_mesh
+    from flyimg_tpu.runtime.metrics import MetricsRegistry
+
+    params = AppParameters(
+        {"upload_dir": str(tmp_path / "u"), "tmp_dir": str(tmp_path / "t")}
+    )
+    metrics = MetricsRegistry()
+    handler = ImageHandler(
+        make_storage(params), params,
+        sp_mesh=make_mesh(axis_names=("sp",)), metrics=metrics,
+    )
+    rng = np.random.default_rng(12)
+    arr = rng.integers(0, 255, (2161, 512, 3), dtype=np.uint8)
+    src = str(tmp_path / "tall.png")
+    Image.fromarray(arr).save(src)
+    result = handler.process_image("w_256,o_png", src)
+    out = Image.open(io.BytesIO(result.content))
+    assert out.size == (256, 1081)  # aspect-fit of 2161x512 (ceil-ish rounding)
+    assert (
+        metrics.summary().get("flyimg_tiled_resamples_total") == 1.0
+    ), "did not take the tiled path"

@@ -75,10 +75,19 @@ def test_tiled_resample_matches_single_device(out_h, out_w):
     np.testing.assert_allclose(got, want, atol=0.75)
 
 
-def test_tiled_resample_rejects_indivisible_height():
+def test_tiled_resample_pads_indivisible_heights():
+    """2161-row-style inputs (and indivisible out_h) must ride the tiled
+    path via pad-to-divisible, matching the one-device program."""
     mesh = make_mesh(axis_names=("sp",))
-    with pytest.raises(ValueError):
-        tiled_transform(jnp.zeros((100, 64, 3)), (64, 64), mesh)
+    img = RNG.integers(0, 256, size=(515, 96, 3), dtype=np.uint8)
+    got = np.asarray(tiled_transform(jnp.asarray(img), (123, 64), mesh))
+    assert got.shape == (123, 64, 3)
+    want = np.asarray(
+        single_resize(
+            jnp.asarray(img, jnp.float32), 123, 64, method="lanczos3"
+        )
+    )
+    np.testing.assert_allclose(got, want, atol=0.75)
 
 
 def test_data_parallel_serving_fanout():
@@ -101,3 +110,12 @@ def test_data_parallel_serving_fanout():
     got = np.asarray(jitted(jax.device_put(batch, sharding)))
     want = np.asarray(jax.vmap(program)(batch))
     np.testing.assert_allclose(got, want, atol=1e-3)
+
+
+def test_tiled_resample_infeasible_halo_raises():
+    """Extreme downscales whose halo would exceed a tile must refuse (the
+    handler falls back to the batcher) instead of clamping and corrupting."""
+    mesh = make_mesh(axis_names=("sp",))
+    img = np.zeros((4001, 64, 3), dtype=np.uint8)
+    with pytest.raises(ValueError, match="infeasible"):
+        tiled_transform(jnp.asarray(img), (33, 64), mesh)
