@@ -74,27 +74,28 @@ def decode(
                     orig_size=(info.width or rgb.shape[1], info.height or rgb.shape[0]),
                 )
         elif info.mime == "image/webp" and frame == 0:
-            rgb = native_codec.webp_decode(data)
-            if rgb is not None:
-                return DecodedImage(
-                    rgb=np.ascontiguousarray(rgb),
-                    alpha=None,
-                    mime="image/webp",
-                    orig_size=(rgb.shape[1], rgb.shape[0]),
-                )
+            decoded = native_codec.webp_decode_auto(data)
+            if decoded is not None:
+                return _split_alpha(decoded, "image/webp")
         elif info.mime == "image/png":
             decoded = native_codec.png_decode(data)
             if decoded is not None:
-                pixels, channels = decoded
-                alpha = pixels[..., 3].copy() if channels == 4 else None
-                rgb = np.ascontiguousarray(pixels[..., :3])
-                return DecodedImage(
-                    rgb=rgb,
-                    alpha=alpha,
-                    mime="image/png",
-                    orig_size=(rgb.shape[1], rgb.shape[0]),
-                )
+                return _split_alpha(decoded, "image/png")
     return pil_codec.decode(data, target_hint=target_hint, frame=frame)
+
+
+def _split_alpha(decoded, mime: str) -> DecodedImage:
+    """(pixels [h, w, 3|4], channels) -> DecodedImage with RAW rgb + a
+    separate alpha plane (the contract every decode path shares)."""
+    pixels, channels = decoded
+    alpha = pixels[..., 3].copy() if channels == 4 else None
+    rgb = np.ascontiguousarray(pixels[..., :3])
+    return DecodedImage(
+        rgb=rgb,
+        alpha=alpha,
+        mime=mime,
+        orig_size=(rgb.shape[1], rgb.shape[0]),
+    )
 
 
 def jpeg_batch_scale_num(data_info: MediaInfo, target_hint) -> int:
@@ -145,6 +146,13 @@ def encode(
         blob = native_codec.png_encode(pixels)
         if blob is not None:
             return blob
+    if native_codec.available() and fmt == "webp":
+        pixels = image if alpha is None else np.dstack([image, alpha])
+        blob = native_codec.webp_encode(
+            pixels, quality, lossless=bool(webp_lossless)
+        )
+        if blob is not None:
+            return blob
     if native_codec.available() and alpha is None:
         if fmt in ("jpg", "jpeg"):
             if mozjpeg:
@@ -162,12 +170,6 @@ def encode(
                 optimize=bool(mozjpeg),
                 progressive=bool(mozjpeg),
                 subsampling_444=(sampling_factor == "1x1"),
-            )
-            if blob is not None:
-                return blob
-        elif fmt == "webp":
-            blob = native_codec.webp_encode(
-                image, quality, lossless=bool(webp_lossless)
             )
             if blob is not None:
                 return blob

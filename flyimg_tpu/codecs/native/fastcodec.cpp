@@ -778,19 +778,34 @@ int fc_probe(const uint8_t* d, size_t n, int* width, int* height, int* depth) {
 // WebP
 // ---------------------------------------------------------------------------
 
-uint8_t* fc_webp_decode(const uint8_t* data, size_t len, int* width,
-                        int* height) {
-  return WebPDecodeRGB(data, len, width, height);
+// Decode preserving alpha when the file carries it: fills channels with 3
+// or 4 and returns tightly packed RGB/RGBA accordingly (cwebp/dwebp parity
+// for transparent sources).
+uint8_t* fc_webp_decode_auto(const uint8_t* data, size_t len, int* width,
+                             int* height, int* channels) {
+  WebPBitstreamFeatures feat;
+  if (WebPGetFeatures(data, len, &feat) != VP8_STATUS_OK) return nullptr;
+  *channels = feat.has_alpha ? 4 : 3;
+  return feat.has_alpha ? WebPDecodeRGBA(data, len, width, height)
+                        : WebPDecodeRGB(data, len, width, height);
 }
 
-uint8_t* fc_webp_encode(const uint8_t* rgb, int width, int height,
-                        float quality, int lossless, size_t* out_len) {
+// Encode tightly packed RGB (channels=3) or RGBA (channels=4) — one entry
+// point like fc_png_encode, alpha selected by the pixel layout.
+uint8_t* fc_webp_encode(const uint8_t* pixels, int width, int height,
+                        int channels, float quality, int lossless,
+                        size_t* out_len) {
   uint8_t* out = nullptr;
+  const int stride = width * channels;
   size_t n;
-  if (lossless) {
-    n = WebPEncodeLosslessRGB(rgb, width, height, width * 3, &out);
+  if (channels == 4) {
+    n = lossless
+            ? WebPEncodeLosslessRGBA(pixels, width, height, stride, &out)
+            : WebPEncodeRGBA(pixels, width, height, stride, quality, &out);
   } else {
-    n = WebPEncodeRGB(rgb, width, height, width * 3, quality, &out);
+    n = lossless
+            ? WebPEncodeLosslessRGB(pixels, width, height, stride, &out)
+            : WebPEncodeRGB(pixels, width, height, stride, quality, &out);
   }
   if (n == 0) return nullptr;
   *out_len = n;

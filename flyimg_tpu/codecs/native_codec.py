@@ -93,15 +93,16 @@ def _load():
             ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
             ctypes.POINTER(ctypes.c_int),
         ]
-        lib.fc_webp_decode.restype = ctypes.c_void_p
-        lib.fc_webp_decode.argtypes = [
+        lib.fc_webp_decode_auto.restype = ctypes.c_void_p
+        lib.fc_webp_decode_auto.argtypes = [
             ctypes.c_char_p, ctypes.c_size_t,
             ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int),
         ]
         lib.fc_webp_encode.restype = ctypes.c_void_p
         lib.fc_webp_encode.argtypes = [
-            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_float,
-            ctypes.c_int, ctypes.POINTER(ctypes.c_size_t),
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_float, ctypes.c_int, ctypes.POINTER(ctypes.c_size_t),
         ]
         lib.fc_free.argtypes = [ctypes.c_void_p]
         lib.fc_pool_create.restype = ctypes.c_void_p
@@ -267,30 +268,38 @@ def png_encode(pixels: np.ndarray) -> Optional[bytes]:
     return arr.tobytes()
 
 
-def webp_decode(data: bytes) -> Optional[np.ndarray]:
+def webp_decode_auto(data: bytes) -> Optional[Tuple[np.ndarray, int]]:
+    """(pixels, channels) with channels 4 iff the file carries alpha."""
     lib = _load()
     if not lib:
         return None
     w = ctypes.c_int()
     h = ctypes.c_int()
-    ptr = lib.fc_webp_decode(data, len(data), ctypes.byref(w), ctypes.byref(h))
+    ch = ctypes.c_int()
+    ptr = lib.fc_webp_decode_auto(
+        data, len(data), ctypes.byref(w), ctypes.byref(h), ctypes.byref(ch)
+    )
     if not ptr:
         return None
-    arr = _take_buffer(lib, ptr, w.value * h.value * 3)
-    return arr.reshape(h.value, w.value, 3)
+    arr = _take_buffer(lib, ptr, w.value * h.value * ch.value)
+    return arr.reshape(h.value, w.value, ch.value), ch.value
 
 
 def webp_encode(
-    rgb: np.ndarray, quality: int = 90, lossless: bool = False
+    pixels: np.ndarray, quality: int = 90, lossless: bool = False
 ) -> Optional[bytes]:
+    """[h, w, 3|4] uint8 -> WebP; alpha selected by the pixel layout
+    (cwebp parity for transparent outputs)."""
     lib = _load()
     if not lib:
         return None
-    rgb = np.ascontiguousarray(rgb, dtype=np.uint8)
-    h, w = rgb.shape[:2]
+    pixels = np.ascontiguousarray(pixels, dtype=np.uint8)
+    h, w = pixels.shape[:2]
+    channels = pixels.shape[2]
     out_len = ctypes.c_size_t()
     ptr = lib.fc_webp_encode(
-        rgb.tobytes(), w, h, float(quality), int(lossless), ctypes.byref(out_len)
+        pixels.tobytes(), w, h, channels, float(quality), int(lossless),
+        ctypes.byref(out_len),
     )
     if not ptr:
         return None

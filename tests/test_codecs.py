@@ -270,3 +270,33 @@ def test_moz_flag_switches_encoder(tmp_path):
     assert moz.content != plain.content
     for blob in (moz.content, plain.content):
         assert Image.open(io.BytesIO(blob)).size == (150, 100)
+
+
+def test_webp_alpha_round_trip_native():
+    """Transparent WebP must keep its alpha through the native codec in
+    BOTH directions (cwebp/dwebp parity; the RGB-only path would
+    silently flatten)."""
+    from flyimg_tpu.codecs import native_codec
+
+    if not native_codec.available():
+        pytest.skip("fastcodec not built")
+    img = _img(seed=6)
+    alpha = np.linspace(10, 245, 40 * 56, dtype=np.uint8).reshape(40, 56)
+    blob = encode(img, "webp", alpha=alpha, webp_lossless=True)
+    out = decode(blob)
+    assert out.mime == "image/webp"
+    assert out.alpha is not None
+    np.testing.assert_array_equal(out.alpha, alpha)
+    np.testing.assert_array_equal(out.rgb, img)
+
+
+def test_webp_opaque_still_rgb():
+    from flyimg_tpu.codecs import native_codec
+
+    if not native_codec.available():
+        pytest.skip("fastcodec not built")
+    img = _img(seed=7)
+    blob = encode(img, "webp", webp_lossless=True)
+    out = decode(blob)
+    assert out.alpha is None
+    np.testing.assert_array_equal(out.rgb, img)
