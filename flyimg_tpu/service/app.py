@@ -118,6 +118,32 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
     from flyimg_tpu.runtime.metrics import MetricsRegistry
 
     metrics = MetricsRegistry()
+    import jax
+
+    # persistent XLA compilation cache: programs compiled once survive
+    # process restarts, so a redeployed server doesn't pay the 20-40 s
+    # first-compile for every shape bucket again (set to '' to disable).
+    # Best-effort: an unwritable location must not turn an optimization
+    # into a boot failure.
+    cache_dir = params.by_key("compilation_cache_dir", "var/cache/xla")
+    if cache_dir:
+        import logging
+        import os
+
+        try:
+            os.makedirs(cache_dir, exist_ok=True)
+            jax.config.update(
+                "jax_compilation_cache_dir", os.path.abspath(cache_dir)
+            )
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.5
+            )
+        except OSError as exc:
+            logging.getLogger(__name__).warning(
+                "compilation cache disabled (%s unwritable: %s)",
+                cache_dir, exc,
+            )
+
     # with more than one chip, shard every batch over a data-parallel mesh
     # (SPMD fan-out — the v4-8 serving story; parallel/mesh.py). Serving
     # meshes span LOCAL devices only: each pod host runs its own batcher
@@ -128,8 +154,6 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
     # the training/offline story (parallel/dist.py, __graft_entry__).
     mesh = None
     sp_mesh = None
-    import jax
-
     local_devices = jax.local_devices()
     if len(local_devices) > 1:
         from flyimg_tpu.parallel.mesh import make_mesh

@@ -197,3 +197,31 @@ def test_route_patterns_config_overridable(tmp_path, source_png):
         params_extra={"routes": {"upload": "/img/{options}/{imageSrc:.+}"}},
     )
     assert status == 404
+
+
+def test_compilation_cache_configured(tmp_path):
+    """make_app arms the persistent XLA compilation cache so restarted
+    servers skip recompiles; the dir must be created and jax configured."""
+    import jax
+
+    from flyimg_tpu.appconfig import AppParameters
+    from flyimg_tpu.service.app import make_app
+
+    cache_dir = tmp_path / "xla-cache"
+    params = AppParameters(
+        {
+            "upload_dir": str(tmp_path / "u"),
+            "tmp_dir": str(tmp_path / "t"),
+            "compilation_cache_dir": str(cache_dir),
+        }
+    )
+    app = make_app(params)
+    try:
+        assert cache_dir.is_dir()
+        assert jax.config.jax_compilation_cache_dir == str(cache_dir)
+    finally:
+        async def cleanup():
+            for cb in app.on_cleanup:
+                await cb(app)
+
+        _run(cleanup())
