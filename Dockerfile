@@ -28,7 +28,6 @@ RUN apt-get update && apt-get install -y --no-install-recommends \
 WORKDIR /app
 COPY pyproject.toml README.md ./
 COPY flyimg_tpu ./flyimg_tpu
-COPY web ./web
 COPY --from=build /app/flyimg_tpu/codecs/native/libfastcodec.so \
      ./flyimg_tpu/codecs/native/libfastcodec.so
 

@@ -376,7 +376,10 @@ class ImageHandler:
             ]
 
         t = time.perf_counter()
-        out_frames = []
+        # submit every frame before waiting on any: coalesced GIF frames
+        # share one program identity, so the batcher runs them as a single
+        # vmapped launch instead of n_frames serial device round-trips
+        staged = []
         for frame in frames:
             fh, fw = frame.shape[:2]
             frame_plan = plan if (fw, fh) == plan.src_size else build_plan(
@@ -384,18 +387,19 @@ class ImageHandler:
             )
             tiled = self._tiled_or_none(frame, frame_plan)
             if tiled is not None:
-                out_frames.append(tiled)
+                staged.append(tiled)
             elif self.batcher is not None:
                 # concurrent requests sharing a program batch into one
                 # device launch; .result() parks this worker thread while
                 # the group fills (flyimg_tpu/runtime/batcher.py)
-                out_frames.append(
-                    self.batcher.submit(frame, frame_plan).result(
-                        timeout=self.DEVICE_RESULT_TIMEOUT_S
-                    )
-                )
+                staged.append(self.batcher.submit(frame, frame_plan))
             else:
-                out_frames.append(run_plan(frame, frame_plan))
+                staged.append(run_plan(frame, frame_plan))
+        out_frames = [
+            s.result(timeout=self.DEVICE_RESULT_TIMEOUT_S)
+            if isinstance(s, Future) else s
+            for s in staged
+        ]
         timings["device"] = time.perf_counter() - t
 
         # post-passes on the transformed output, in reference order:

@@ -456,6 +456,39 @@ def test_batched_postpasses_match_direct(tmp_path):
         batcher.close()
 
 
+def test_animated_gif_frames_share_one_batch(tmp_path):
+    """All frames of an animated GIF are submitted before any wait, so the
+    batcher runs them as one vmapped launch (they share program identity),
+    not n_frames serial device round-trips."""
+    from flyimg_tpu.runtime.batcher import BatchController
+
+    params = AppParameters(
+        {
+            "upload_dir": str(tmp_path / "u-gif"),
+            "tmp_dir": str(tmp_path / "t-gif"),
+        }
+    )
+    storage = make_storage(params)
+    frames = [
+        Image.fromarray(np.full((60, 80, 3), c, dtype=np.uint8))
+        for c in (30, 90, 150, 210)
+    ]
+    src = str(tmp_path / "batchanim.gif")
+    frames[0].save(src, save_all=True, append_images=frames[1:], duration=80, loop=0)
+
+    batcher = BatchController(max_batch=8, deadline_ms=40.0, lone_flush=False)
+    try:
+        handler = ImageHandler(storage, params, batcher=batcher)
+        result = handler.process_image("w_40,o_gif", src)
+        out = Image.open(io.BytesIO(result.content))
+        assert out.format == "GIF" and out.n_frames == 4
+        summary = batcher.metrics.summary()
+        assert summary.get("flyimg_images_processed_total") == 4.0
+        assert summary.get("flyimg_batches_total") == 1.0
+    finally:
+        batcher.close()
+
+
 def test_alpha_flattens_over_bg_color(env):
     """IM flattens alpha over -background (bg_), not hardcoded white;
     geometry ops drop the alpha channel so the flatten color shows."""

@@ -215,6 +215,15 @@ def test_compilation_cache_configured(tmp_path):
             "compilation_cache_dir": str(cache_dir),
         }
     )
+    # make_app mutates process-global jax config; restore it so later tests
+    # in this process don't silently write cache artifacts into tmp_path
+    saved = {
+        name: getattr(jax.config, name)
+        for name in (
+            "jax_compilation_cache_dir",
+            "jax_persistent_cache_min_compile_time_secs",
+        )
+    }
     app = make_app(params)
     try:
         assert cache_dir.is_dir()
@@ -225,3 +234,5 @@ def test_compilation_cache_configured(tmp_path):
                 await cb(app)
 
         _run(cleanup())
+        for name, value in saved.items():
+            jax.config.update(name, value)
