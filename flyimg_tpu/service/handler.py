@@ -78,6 +78,9 @@ class ProcessedImage:
     options: OptionsBag
     from_cache: bool = False
     timings: Dict[str, float] = field(default_factory=dict)
+    # stored artifact's mtime (reference Last-Modified source,
+    # Response.php:72-78); None -> response falls back to now()
+    modified_at: Optional[float] = None
 
 
 class ImageHandler:
@@ -168,10 +171,13 @@ class ImageHandler:
         )
 
         refresh = options.wants_refresh()
-        if refresh and self.storage.has(spec.name):
-            self.storage.delete(spec.name)
+        if refresh:
+            self.storage.delete(spec.name)  # idempotent when absent
 
-        if self.storage.has(spec.name):
+        # ONE metadata round trip answers cached? + stored-when? (an
+        # extra per-hit HeadObject would otherwise tax S3 serving)
+        stat = None if refresh else self.storage.stat(spec.name)
+        if stat is not None:
             if self.metrics is not None:
                 self.metrics.record_cache(hit=True)
                 self.metrics.record_stage("cache_hit", time.perf_counter() - t0)
@@ -181,6 +187,7 @@ class ImageHandler:
                 options=options,
                 from_cache=True,
                 timings=timings,
+                modified_at=stat.mtime,
             )
 
         leader, flight = self._singleflight.begin(spec.name)
@@ -193,7 +200,7 @@ class ImageHandler:
                 # generous multiple of the per-device-call budget: a slow
                 # but healthy leader (multi-frame GIF, several post-pass
                 # waits) must NOT shed its followers — only a wedged one
-                content = flight.result(
+                content, modified_at = flight.result(
                     timeout=5 * self.DEVICE_RESULT_TIMEOUT_S
                 )
             except FutureTimeout:
@@ -213,23 +220,27 @@ class ImageHandler:
                     "Cache-miss requests served by an in-flight duplicate",
                 ).inc()
             return ProcessedImage(
-                content=content, spec=spec, options=options, timings=timings
+                content=content, spec=spec, options=options, timings=timings,
+                modified_at=modified_at,
             )
 
         try:
             content = self._process_new(source.data, options, spec, timings)
-            self.storage.write(spec.name, content)
+            # write() returns the stored mtime so neither the leader nor
+            # its followers re-query metadata for bytes written just now
+            modified_at = self.storage.write(spec.name, content)
         except BaseException as exc:
             self._singleflight.done(spec.name, exc=exc)
             raise
-        self._singleflight.done(spec.name, result=content)
+        self._singleflight.done(spec.name, result=(content, modified_at))
         timings["total"] = time.perf_counter() - t0
         if self.metrics is not None:
             self.metrics.record_cache(hit=False)
             for stage, seconds in timings.items():
                 self.metrics.record_stage(stage, seconds)
         return ProcessedImage(
-            content=content, spec=spec, options=options, timings=timings
+            content=content, spec=spec, options=options, timings=timings,
+            modified_at=modified_at,
         )
 
     # ------------------------------------------------------------------

@@ -52,5 +52,10 @@ def image_headers(result: ProcessedImage, header_cache_days: int) -> Dict[str, s
         headers["Expires"] = email.utils.formatdate(
             time.time() + 365 * 24 * 3600, usegmt=True
         )
-    headers["Last-Modified"] = email.utils.formatdate(time.time(), usegmt=True)
+    # stored artifact's mtime like the reference (Response.php:72-78);
+    # now() only when the backend can't say (e.g. S3 head failure)
+    headers["Last-Modified"] = email.utils.formatdate(
+        result.modified_at if result.modified_at is not None else time.time(),
+        usegmt=True,
+    )
     return headers

@@ -4,7 +4,17 @@ has/read/write/delete + public URL; reference LocalStorageProvider.php:26-48).""
 from __future__ import annotations
 
 import abc
+from dataclasses import dataclass
 from typing import Optional
+
+
+@dataclass(frozen=True)
+class StorageStat:
+    """Cheap metadata for a stored artifact. ``mtime`` (unix time) feeds the
+    Last-Modified header (reference Response.php:72-78 uses the upload
+    file's mtime); None -> the response layer falls back to now()."""
+
+    mtime: Optional[float] = None
 
 
 class Storage(abc.ABC):
@@ -15,7 +25,10 @@ class Storage(abc.ABC):
     def read(self, name: str) -> bytes: ...
 
     @abc.abstractmethod
-    def write(self, name: str, data: bytes) -> None: ...
+    def write(self, name: str, data: bytes) -> Optional[float]:
+        """Store the artifact; returns its mtime when cheaply known (so the
+        serving path never issues a metadata round trip for an object it
+        just wrote), else None."""
 
     @abc.abstractmethod
     def delete(self, name: str) -> None: ...
@@ -23,3 +36,9 @@ class Storage(abc.ABC):
     @abc.abstractmethod
     def public_url(self, name: str, request_base: Optional[str] = None) -> str:
         """Public URL for the /path route (reference Response.php:108-113)."""
+
+    def stat(self, name: str) -> Optional[StorageStat]:
+        """One round trip answering BOTH "is it cached?" and "when was it
+        stored?" — None when absent. Default composes has(); backends
+        override with a single native call (os.stat / S3 HeadObject)."""
+        return StorageStat() if self.has(name) else None

@@ -9,7 +9,7 @@ from __future__ import annotations
 import os
 from typing import Optional
 
-from flyimg_tpu.storage.base import Storage
+from flyimg_tpu.storage.base import Storage, StorageStat
 
 UPLOAD_WEB_DIR = "uploads/"
 
@@ -32,7 +32,7 @@ class LocalStorage(Storage):
         with open(self._path(name), "rb") as fh:
             return fh.read()
 
-    def write(self, name: str, data: bytes) -> None:
+    def write(self, name: str, data: bytes):
         path = self._path(name)
         tmp = path + ".part"
         with open(tmp, "wb") as fh:
@@ -41,12 +41,22 @@ class LocalStorage(Storage):
         # (last-write-wins, like the reference's Flysystem write;
         # SURVEY.md section 5 'race detection')
         os.replace(tmp, path)
+        try:
+            return os.path.getmtime(path)
+        except OSError:
+            return None
 
     def delete(self, name: str) -> None:
         try:
             os.remove(self._path(name))
         except FileNotFoundError:
             pass
+
+    def stat(self, name: str):
+        try:
+            return StorageStat(mtime=os.stat(self._path(name)).st_mtime)
+        except OSError:
+            return None
 
     def public_url(self, name: str, request_base: Optional[str] = None) -> str:
         base = os.environ.get("HOSTNAME_URL") or request_base or ""

@@ -6,10 +6,12 @@ Validates credentials up front like the reference (S3StorageProvider.php:
 
 from __future__ import annotations
 
+import email.utils
+import time
 from typing import Optional
 
 from flyimg_tpu.exceptions import MissingParamsException
-from flyimg_tpu.storage.base import Storage
+from flyimg_tpu.storage.base import Storage, StorageStat
 
 
 class S3Storage(Storage):
@@ -48,11 +50,28 @@ class S3Storage(Storage):
         obj = self._client.get_object(Bucket=self.bucket, Key=name)
         return obj["Body"].read()
 
-    def write(self, name: str, data: bytes) -> None:
-        self._client.put_object(Bucket=self.bucket, Key=name, Body=data)
+    def write(self, name: str, data: bytes) -> Optional[float]:
+        resp = self._client.put_object(Bucket=self.bucket, Key=name, Body=data)
+        # PutObject returns no LastModified, but its Date header carries
+        # S3's OWN clock — the same clock later HeadObjects report — so the
+        # Last-Modified seen on the miss response and on every later cache
+        # hit agree even when the server clock is skewed (and no HeadObject
+        # is spent on an object written just now)
+        try:
+            date = resp["ResponseMetadata"]["HTTPHeaders"]["date"]
+            return email.utils.parsedate_to_datetime(date).timestamp()
+        except Exception:
+            return time.time()
 
     def delete(self, name: str) -> None:
         self._client.delete_object(Bucket=self.bucket, Key=name)
+
+    def stat(self, name: str):
+        try:
+            head = self._client.head_object(Bucket=self.bucket, Key=name)
+            return StorageStat(mtime=head["LastModified"].timestamp())
+        except Exception:
+            return None
 
     def public_url(self, name: str, request_base: Optional[str] = None) -> str:
         return f"https://s3.{self.region}.amazonaws.com/{self.bucket}/{name}"

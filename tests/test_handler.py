@@ -66,6 +66,46 @@ def test_resize_and_cache_roundtrip(env):
     assert again.content == result.content
 
 
+def test_geometry_oracle_end_to_end_batched(tmp_path):
+    """One reference geometry-oracle case per family (fit shrink, crop-fill,
+    no-upscale expand, partial crop — ImageProcessorTest.php providers),
+    through the FULL pipeline with a real batcher: decode -> bucket pad ->
+    vmapped program -> valid-region slice -> encode must land on the exact
+    oracle dims, not just the plan computation (tests/test_geometry.py)."""
+    from flyimg_tpu.runtime.batcher import BatchController
+
+    params = AppParameters(
+        {
+            "upload_dir": str(tmp_path / "u-geo"),
+            "tmp_dir": str(tmp_path / "t-geo"),
+        }
+    )
+    storage = make_storage(params)
+    rng = np.random.default_rng(3)
+
+    # (options, src (w, h), expected output (w, h)) from the oracle tables
+    cases = [
+        ("w_300,h_150", (900, 600), (225, 150)),     # fit shrink
+        ("w_300,h_250,c_1", (900, 600), (300, 250)),  # crop-fill
+        ("w_400,h_300", (300, 200), (300, 200)),      # no-upscale default
+        ("w_250,h_250,c_1", (300, 200), (250, 200)),  # partial crop clamp
+    ]
+    batcher = BatchController(max_batch=8, deadline_ms=5.0)
+    try:
+        handler = ImageHandler(storage, params, batcher=batcher)
+        for options_str, (sw, sh), expected in cases:
+            src = str(tmp_path / f"geo-{sw}x{sh}.png")
+            if not os.path.exists(src):
+                Image.fromarray(
+                    rng.integers(0, 255, (sh, sw, 3), dtype=np.uint8)
+                ).save(src)
+            result = handler.process_image(f"{options_str},o_png", src)
+            out = Image.open(io.BytesIO(result.content))
+            assert out.size == expected, (options_str, out.size, expected)
+    finally:
+        batcher.close()
+
+
 def test_format_matrix_png_source(env):
     handler, _, tmp = env
     src = _write_png(tmp / "b.png")

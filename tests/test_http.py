@@ -84,6 +84,27 @@ def test_upload_serves_image_with_cache_headers(tmp_path, source_png):
     assert (out.rgb.shape[1], out.rgb.shape[0]) == (32, 24)
 
 
+def test_last_modified_tracks_stored_artifact(tmp_path, source_png):
+    """Last-Modified is the stored artifact's mtime (reference
+    Response.php:72-78), so repeated cache hits serve a STABLE value
+    instead of re-stamping now() on every request."""
+    import email.utils
+    import os
+    import time
+
+    path = f"/upload/w_32,o_png/{source_png}"
+    _, h1, _ = _request(tmp_path, path)
+    time.sleep(1.1)  # HTTP-date is second-granular
+    _, h2, _ = _request(tmp_path, path)  # cache hit in the same upload_dir
+    assert h1["Last-Modified"] == h2["Last-Modified"]
+    stored = next(
+        (tmp_path / "uploads").glob("*.png")
+    )
+    assert email.utils.parsedate_to_datetime(
+        h2["Last-Modified"]
+    ).timestamp() == int(os.path.getmtime(stored))
+
+
 def test_upload_webp_negotiation(tmp_path, source_png):
     status, headers, _ = _request(
         tmp_path,

@@ -94,9 +94,15 @@ class _FakeClient:
         self.blobs = {}
 
     def head_object(self, Bucket, Key):
+        import datetime
+
         if Key not in self.blobs:
             raise RuntimeError("404")
-        return {}
+        return {
+            "LastModified": datetime.datetime(
+                2026, 1, 2, 3, 4, 5, tzinfo=datetime.timezone.utc
+            )
+        }
 
     def get_object(self, Bucket, Key):
         data = self.blobs[Key]
@@ -142,3 +148,29 @@ def test_s3_read_failure_bubbles(s3):
     storage, _ = s3
     with pytest.raises(KeyError):
         storage.read("missing.jpg")
+
+
+def test_local_stat_and_write_mtime(local):
+    """stat() answers cached?+when? in one os.stat; write() returns the
+    stored mtime so the miss path never re-queries metadata."""
+    import os
+
+    assert local.stat("none.jpg") is None
+    wrote = local.write("m.jpg", b"x")
+    st = local.stat("m.jpg")
+    assert wrote is not None and st is not None
+    assert st.mtime == wrote == os.path.getmtime(local._path("m.jpg"))
+
+
+def test_s3_stat_single_head(s3):
+    """S3 stat() maps to ONE HeadObject: LastModified timestamp when
+    present, None when the head fails (absent object)."""
+    storage, _ = s3
+    assert storage.stat("none.webp") is None
+    assert storage.write("k.webp", b"payload") is not None
+    st = storage.stat("k.webp")
+    import datetime
+
+    assert st.mtime == datetime.datetime(
+        2026, 1, 2, 3, 4, 5, tzinfo=datetime.timezone.utc
+    ).timestamp()
