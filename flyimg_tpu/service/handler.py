@@ -174,15 +174,16 @@ class ImageHandler:
         if refresh:
             self.storage.delete(spec.name)  # idempotent when absent
 
-        # ONE metadata round trip answers cached? + stored-when? (an
-        # extra per-hit HeadObject would otherwise tax S3 serving)
-        stat = None if refresh else self.storage.stat(spec.name)
-        if stat is not None:
+        # ONE round trip answers cached? + bytes + stored-when? (separate
+        # has/read/head calls would tax S3 serving's hot path 2-3x)
+        cached = None if refresh else self.storage.fetch(spec.name)
+        if cached is not None:
+            content, stat = cached
             if self.metrics is not None:
                 self.metrics.record_cache(hit=True)
                 self.metrics.record_stage("cache_hit", time.perf_counter() - t0)
             return ProcessedImage(
-                content=self.storage.read(spec.name),
+                content=content,
                 spec=spec,
                 options=options,
                 from_cache=True,

@@ -42,3 +42,21 @@ class Storage(abc.ABC):
         stored?" — None when absent. Default composes has(); backends
         override with a single native call (os.stat / S3 HeadObject)."""
         return StorageStat() if self.has(name) else None
+
+    def fetch(self, name: str) -> Optional[tuple]:
+        """(bytes, StorageStat) in ONE round trip, or None when absent —
+        the cache-hit serving path (existence + bytes + mtime together;
+        S3's GetObject already carries LastModified, local disk answers
+        with one open+fstat). Default composes stat()+read() for backends
+        without a cheaper combined call."""
+        st = self.stat(name)
+        if st is None:
+            return None
+        try:
+            return self.read(name), st
+        except Exception:
+            # stat->read race: a concurrent delete (rf_1) between the two
+            # calls must surface as "absent", not a 500
+            if self.stat(name) is None:
+                return None
+            raise

@@ -58,6 +58,15 @@ class LocalStorage(Storage):
         except OSError:
             return None
 
+    def fetch(self, name: str):
+        try:
+            with open(self._path(name), "rb") as fh:
+                data = fh.read()
+                mtime = os.fstat(fh.fileno()).st_mtime
+        except OSError:
+            return None
+        return data, StorageStat(mtime=mtime)
+
     def public_url(self, name: str, request_base: Optional[str] = None) -> str:
         base = os.environ.get("HOSTNAME_URL") or request_base or ""
         return f"{base.rstrip('/')}/{UPLOAD_WEB_DIR}{name}"

@@ -6,7 +6,6 @@ Validates credentials up front like the reference (S3StorageProvider.php:
 
 from __future__ import annotations
 
-import email.utils
 import time
 from typing import Optional
 
@@ -39,29 +38,43 @@ class S3Storage(Storage):
             region_name=self.region,
         )
 
+    @staticmethod
+    def _is_not_found(exc: Exception) -> bool:
+        """Only genuine not-found responses mean "cache miss". Anything
+        else (throttling, network, AccessDenied) must PROPAGATE: treating
+        an S3 outage as a miss would silently recompute + rewrite every
+        request — a cost amplification with no error signal. Duck-typed on
+        botocore ClientError's response shape so the boto3 import stays
+        gated."""
+        code = ""
+        response = getattr(exc, "response", None)
+        if isinstance(response, dict):
+            code = str(response.get("Error", {}).get("Code", ""))
+        return code in ("404", "NoSuchKey", "NotFound")
+
     def has(self, name: str) -> bool:
         try:
             self._client.head_object(Bucket=self.bucket, Key=name)
             return True
-        except Exception:
-            return False
+        except Exception as exc:
+            if self._is_not_found(exc):
+                return False
+            raise
 
     def read(self, name: str) -> bytes:
         obj = self._client.get_object(Bucket=self.bucket, Key=name)
         return obj["Body"].read()
 
     def write(self, name: str, data: bytes) -> Optional[float]:
-        resp = self._client.put_object(Bucket=self.bucket, Key=name, Body=data)
-        # PutObject returns no LastModified, but its Date header carries
-        # S3's OWN clock — the same clock later HeadObjects report — so the
-        # Last-Modified seen on the miss response and on every later cache
-        # hit agree even when the server clock is skewed (and no HeadObject
-        # is spent on an object written just now)
-        try:
-            date = resp["ResponseMetadata"]["HTTPHeaders"]["date"]
-            return email.utils.parsedate_to_datetime(date).timestamp()
-        except Exception:
-            return time.time()
+        self._client.put_object(Bucket=self.bucket, Key=name, Body=data)
+        # PutObject returns no LastModified; read back the object's OWN
+        # stamp so the miss response and every later cache hit serve the
+        # IDENTICAL validator (Date-header/local-clock approximations can
+        # disagree with LastModified by a second — enough to make a CDN
+        # re-fetch unchanged bytes). One HeadObject per miss; hits pay
+        # nothing (fetch() rides GetObject's LastModified).
+        st = self.stat(name)
+        return st.mtime if st is not None else time.time()
 
     def delete(self, name: str) -> None:
         self._client.delete_object(Bucket=self.bucket, Key=name)
@@ -70,8 +83,22 @@ class S3Storage(Storage):
         try:
             head = self._client.head_object(Bucket=self.bucket, Key=name)
             return StorageStat(mtime=head["LastModified"].timestamp())
-        except Exception:
-            return None
+        except Exception as exc:
+            if self._is_not_found(exc):
+                return None
+            raise
+
+    def fetch(self, name: str):
+        try:
+            obj = self._client.get_object(Bucket=self.bucket, Key=name)
+        except Exception as exc:
+            if self._is_not_found(exc):
+                return None
+            raise
+        mtime = None
+        if "LastModified" in obj:
+            mtime = obj["LastModified"].timestamp()
+        return obj["Body"].read(), StorageStat(mtime=mtime)
 
     def public_url(self, name: str, request_base: Optional[str] = None) -> str:
         return f"https://s3.{self.region}.amazonaws.com/{self.bucket}/{name}"

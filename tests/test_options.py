@@ -136,3 +136,55 @@ def test_plan_signature_excludes_src_size():
     b = build_plan(OptionsBag("w_100,h_100,c_1"), 1200, 800)
     assert a.signature() == b.signature()
     assert a != b
+
+
+def test_fuzz_options_never_crash_plan_building():
+    """Seeded sweep of hostile option combinations: every value the URL DSL
+    can carry (garbage, empty, negative, out-of-range) must yield either a
+    valid plan with positive dims or a TYPED AppException — never an
+    unhandled error (the reference silently ignores unknowns/garbage,
+    OptionsBag.php:50; the spec layer must be at least as unkillable)."""
+    import random
+
+    from flyimg_tpu.exceptions import AppException
+    from flyimg_tpu.spec.plan import build_plan
+
+    random.seed(1234)
+    values = {
+        "w": ["100", "0", "-5", "abc", "99999", ""],
+        "h": ["150", "0", "-1", "xyz", ""],
+        "c": ["1", "0", "true", ""],
+        "rz": ["1", "0"],
+        "g": ["Center", "NorthWest", "South", "bogus", ""],
+        "r": ["45", "-45", "90.5", "NaN", "720", "abc"],
+        "sc": ["50", "0", "200", "junk"],
+        "bg": ["red", "#999999", "%23abcdef", "rgb(1,2,3)", "nope"],
+        "blr": ["1x2", "0x0", "bad"],
+        "sh": ["2x1", ""],
+        "unsh": ["0.25x0.25+8+0.065", "broken"],
+        "ett": ["100x80", "0x0", "gibberish"],
+        "e": ["1"],
+        "p1x": ["10", "-5", "zz"], "p1y": ["10"], "p2x": ["50"], "p2y": ["40"],
+        "par": ["0", "1"], "pns": ["0", "1"],
+        "clsp": ["sRGB", "Gray", "wat"],
+        "mnchr": ["1"],
+        "f": ["Lanczos", "Triangle", "Point", "nonsense"],
+        "gf": ["0", "2", "-1", "x"],
+        "smc": ["1", "0"],
+        "fc": ["1"], "fcp": ["0", "3"], "fb": ["1"],
+        "q": ["90", "0", "101", "NaN"],
+        "o": ["auto", "png", "jpg", "webp", "gif", "input"],
+        "st": ["1", "0"], "sf": ["1x1", "2x2", "junk"], "moz": ["1", "0"],
+        "webpl": ["1", "0"],
+    }
+    keys = list(values)
+    for _ in range(1000):
+        picked = random.sample(keys, random.randint(1, 6))
+        opts = ",".join(f"{k}_{random.choice(values[k])}" for k in picked)
+        sw, sh = random.choice([(600, 400), (50, 80), (1, 1), (4096, 2160)])
+        try:
+            plan = build_plan(OptionsBag(opts), sw, sh)
+        except AppException:
+            continue  # typed rejection is contract-conform
+        w, h = plan.final_size
+        assert w >= 1 and h >= 1, (opts, w, h)

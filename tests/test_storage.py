@@ -87,31 +87,50 @@ def test_s3_missing_boto3_raises(monkeypatch):
         make_storage(params)
 
 
+def _s3_now():
+    import datetime
+
+    return datetime.datetime(2026, 1, 2, 3, 4, 5, tzinfo=datetime.timezone.utc)
+
+
+class _NotFound(Exception):
+    """botocore ClientError shape for a missing key."""
+
+    response = {"Error": {"Code": "NoSuchKey"}}
+
+
 class _FakeClient:
-    """In-memory stand-in for boto3's S3 client (head/get/put/delete)."""
+    """In-memory stand-in for boto3's S3 client (head/get/put/delete),
+    stamping LastModified and raising ClientError-shaped not-founds like
+    the real SDK so the mtime + error-discrimination plumbing is
+    exercised."""
 
     def __init__(self):
         self.blobs = {}
+        self.calls = []
 
     def head_object(self, Bucket, Key):
-        import datetime
-
+        self.calls.append("head")
         if Key not in self.blobs:
-            raise RuntimeError("404")
-        return {
-            "LastModified": datetime.datetime(
-                2026, 1, 2, 3, 4, 5, tzinfo=datetime.timezone.utc
-            )
-        }
+            raise _NotFound("404")
+        return {"LastModified": _s3_now()}
 
     def get_object(self, Bucket, Key):
+        self.calls.append("get")
+        if Key not in self.blobs:
+            raise _NotFound("NoSuchKey")
         data = self.blobs[Key]
-        return {"Body": types.SimpleNamespace(read=lambda: data)}
+        return {
+            "Body": types.SimpleNamespace(read=lambda: data),
+            "LastModified": _s3_now(),
+        }
 
     def put_object(self, Bucket, Key, Body):
+        self.calls.append("put")
         self.blobs[Key] = Body
 
     def delete_object(self, Bucket, Key):
+        self.calls.append("delete")
         self.blobs.pop(Key, None)
 
 
@@ -146,8 +165,32 @@ def test_s3_roundtrip_via_client(s3):
 
 def test_s3_read_failure_bubbles(s3):
     storage, _ = s3
-    with pytest.raises(KeyError):
+    with pytest.raises(Exception):
         storage.read("missing.jpg")
+
+
+def test_s3_non_notfound_errors_propagate(s3):
+    """Throttling/permission errors must NOT read as cache misses: a miss
+    triggers a full recompute + rewrite, so an S3 outage misread as
+    'absent' becomes a silent cost amplification. Only not-found codes
+    map to None/False."""
+
+    class _Denied(Exception):
+        response = {"Error": {"Code": "AccessDenied"}}
+
+    storage, client = s3
+
+    def deny(Bucket, Key):
+        raise _Denied("denied")
+
+    client.head_object = deny
+    client.get_object = deny
+    with pytest.raises(_Denied):
+        storage.stat("k.webp")
+    with pytest.raises(_Denied):
+        storage.fetch("k.webp")
+    with pytest.raises(_Denied):
+        storage.has("k.webp")
 
 
 def test_local_stat_and_write_mtime(local):
@@ -169,8 +212,36 @@ def test_s3_stat_single_head(s3):
     assert storage.stat("none.webp") is None
     assert storage.write("k.webp", b"payload") is not None
     st = storage.stat("k.webp")
-    import datetime
+    assert st.mtime == _s3_now().timestamp()
 
-    assert st.mtime == datetime.datetime(
-        2026, 1, 2, 3, 4, 5, tzinfo=datetime.timezone.utc
-    ).timestamp()
+
+def test_s3_write_returns_objects_own_stamp(s3):
+    """write() reads back the object's LastModified (one HeadObject per
+    miss) so the miss response and later hits serve the SAME validator."""
+    storage, client = s3
+    wrote = storage.write("w.webp", b"x")
+    assert wrote == _s3_now().timestamp()
+    assert client.calls == ["put", "head"]
+
+
+def test_s3_fetch_single_get(s3):
+    """The cache-hit path is ONE GetObject: bytes + LastModified together,
+    None when absent — no head+get double round trip."""
+    storage, client = s3
+    assert storage.fetch("none.webp") is None
+    storage.write("k.webp", b"payload")
+    client.calls.clear()
+    data, st = storage.fetch("k.webp")
+    assert data == b"payload"
+    assert st.mtime == _s3_now().timestamp()
+    assert client.calls == ["get"]
+
+
+def test_local_fetch_single_open(local):
+    assert local.fetch("none.jpg") is None
+    local.write("f.jpg", b"bytes")
+    data, st = local.fetch("f.jpg")
+    import os
+
+    assert data == b"bytes"
+    assert st.mtime == os.path.getmtime(local._path("f.jpg"))
