@@ -39,6 +39,36 @@ def force_cpu_platform(n_devices: int = 1) -> None:
     jax.config.update("jax_num_cpu_devices", n_devices)
 
 
+def ensure_env_platform() -> None:
+    """Re-assert the JAX_PLATFORMS env request into jax.config before the
+    first device query.
+
+    This environment's sitecustomize overwrites the platform selection
+    with 'axon,cpu' at interpreter start, so an operator's
+    ``JAX_PLATFORMS=cpu`` serving config would still initialize the
+    accelerator plugin at boot — and hang there whenever the TPU tunnel
+    is unreachable. The explicit config update runs after the
+    sitecustomize and therefore wins. No-op when the env is unset or the
+    config already honors it."""
+    req = os.environ.get("JAX_PLATFORMS", "").strip()
+    if not req or jax.config.jax_platforms == req:
+        return
+    if req.lower() == "cpu":
+        m = re.search(
+            r"--xla_force_host_platform_device_count=(\d+)",
+            os.environ.get("XLA_FLAGS", ""),
+        )
+        force_cpu_platform(int(m.group(1)) if m else 1)
+    else:
+        # drop any backend the sitecustomize already initialized, or the
+        # config change silently never takes effect (same reason
+        # force_cpu_platform clears)
+        from jax.extend.backend import clear_backends
+
+        clear_backends()
+        jax.config.update("jax_platforms", req)
+
+
 def make_mesh(
     axis_sizes: Optional[Tuple[int, ...]] = None,
     axis_names: Sequence[str] = ("data",),

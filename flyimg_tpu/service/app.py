@@ -120,6 +120,13 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
     metrics = MetricsRegistry()
     import jax
 
+    from flyimg_tpu.parallel.mesh import ensure_env_platform
+
+    # honor an operator's JAX_PLATFORMS request BEFORE any device query:
+    # without this, a cpu-only deployment still initializes the
+    # accelerator plugin at boot (and hangs if its transport is down)
+    ensure_env_platform()
+
     # persistent XLA compilation cache: programs compiled once survive
     # process restarts, so a redeployed server doesn't pay the 20-40 s
     # first-compile for every shape bucket again (set to '' to disable).

@@ -50,7 +50,11 @@ class S3Storage(Storage):
         response = getattr(exc, "response", None)
         if isinstance(response, dict):
             code = str(response.get("Error", {}).get("Code", ""))
-        return code in ("404", "NoSuchKey", "NotFound")
+        # 403/AccessDenied is S3's documented answer for a MISSING key when
+        # credentials lack s3:ListBucket (a common least-privilege setup),
+        # so it must read as a miss; a genuinely broken credential set
+        # still surfaces typed at write() time when PutObject fails.
+        return code in ("404", "NoSuchKey", "NotFound", "403", "AccessDenied")
 
     def has(self, name: str) -> bool:
         try:
@@ -72,8 +76,13 @@ class S3Storage(Storage):
         # IDENTICAL validator (Date-header/local-clock approximations can
         # disagree with LastModified by a second — enough to make a CDN
         # re-fetch unchanged bytes). One HeadObject per miss; hits pay
-        # nothing (fetch() rides GetObject's LastModified).
-        st = self.stat(name)
+        # nothing (fetch() rides GetObject's LastModified). Best-effort:
+        # the bytes ARE stored — a throttled metadata read-back must not
+        # turn a successful write into a failed request.
+        try:
+            st = self.stat(name)
+        except Exception:
+            return time.time()
         return st.mtime if st is not None else time.time()
 
     def delete(self, name: str) -> None:

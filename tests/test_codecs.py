@@ -300,3 +300,29 @@ def test_webp_opaque_still_rgb():
     out = decode(blob)
     assert out.alpha is None
     np.testing.assert_array_equal(out.rgb, img)
+
+
+def test_exif_orientation_matches_pil_all_eight():
+    """The reference always emits -auto-orient (ImageProcessor.php:78); the
+    native JPEG path applies EXIF orientation itself (codecs/exif.py). Pin
+    every orientation 1..8 bit-exactly against PIL's exif_transpose — the
+    same transform ImageMagick's auto-orient performs."""
+    import io
+
+    from PIL import Image, ImageOps
+
+    rng = np.random.default_rng(5)
+    arr = rng.integers(0, 255, (40, 60, 3), dtype=np.uint8)
+    for orient in range(1, 9):
+        img = Image.fromarray(arr)
+        exif = img.getexif()
+        exif[0x0112] = orient
+        buf = io.BytesIO()
+        img.save(buf, "JPEG", quality=98, exif=exif)
+        data = buf.getvalue()
+        ours = decode(data).rgb
+        ref = np.asarray(
+            ImageOps.exif_transpose(Image.open(io.BytesIO(data))).convert("RGB")
+        )
+        assert ours.shape == ref.shape, orient
+        np.testing.assert_array_equal(ours, ref, err_msg=f"orientation {orient}")

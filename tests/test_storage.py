@@ -170,27 +170,59 @@ def test_s3_read_failure_bubbles(s3):
 
 
 def test_s3_non_notfound_errors_propagate(s3):
-    """Throttling/permission errors must NOT read as cache misses: a miss
+    """Throttling/outage errors must NOT read as cache misses: a miss
     triggers a full recompute + rewrite, so an S3 outage misread as
     'absent' becomes a silent cost amplification. Only not-found codes
-    map to None/False."""
+    (including 403/AccessDenied — S3's answer for a missing key without
+    s3:ListBucket) map to None/False."""
+
+    class _Throttled(Exception):
+        response = {"Error": {"Code": "SlowDown"}}
 
     class _Denied(Exception):
         response = {"Error": {"Code": "AccessDenied"}}
 
     storage, client = s3
 
+    def throttle(Bucket, Key):
+        raise _Throttled("503")
+
+    client.head_object = throttle
+    client.get_object = throttle
+    with pytest.raises(_Throttled):
+        storage.stat("k.webp")
+    with pytest.raises(_Throttled):
+        storage.fetch("k.webp")
+    with pytest.raises(_Throttled):
+        storage.has("k.webp")
+
     def deny(Bucket, Key):
         raise _Denied("denied")
 
     client.head_object = deny
     client.get_object = deny
-    with pytest.raises(_Denied):
-        storage.stat("k.webp")
-    with pytest.raises(_Denied):
-        storage.fetch("k.webp")
-    with pytest.raises(_Denied):
-        storage.has("k.webp")
+    # least-privilege IAM: missing key answers 403 -> must read as a miss
+    assert storage.stat("k.webp") is None
+    assert storage.fetch("k.webp") is None
+    assert storage.has("k.webp") is False
+
+
+def test_s3_write_survives_throttled_stamp_readback(s3):
+    """The post-put HeadObject is best-effort: the bytes ARE stored, so a
+    throttled metadata read-back must not turn the write into a 500."""
+
+    class _Throttled(Exception):
+        response = {"Error": {"Code": "SlowDown"}}
+
+    storage, client = s3
+
+    def throttle(Bucket, Key):
+        raise _Throttled("503")
+
+    client.head_object = throttle
+    wrote = storage.write("t.webp", b"x")
+    assert wrote is not None  # time.time() fallback, never an exception
+    assert client.blobs["t.webp"] == b"x"
 
 
 def test_local_stat_and_write_mtime(local):
@@ -245,3 +277,34 @@ def test_local_fetch_single_open(local):
 
     assert data == b"bytes"
     assert st.mtime == os.path.getmtime(local._path("f.jpg"))
+
+
+def test_handler_s3_round_trips_per_request(s3, tmp_path):
+    """Through the real handler: a cache miss costs put+head (write + its
+    validator read-back), a cache hit costs ONE GetObject — the round-trip
+    budget the serving path is designed to (handler.py fetch() comment)."""
+    import numpy as np
+    from PIL import Image
+
+    from flyimg_tpu.service.handler import ImageHandler
+
+    storage, client = s3
+    params = AppParameters({"tmp_dir": str(tmp_path / "t")})
+    handler = ImageHandler(storage, params)
+    src = str(tmp_path / "s3src.png")
+    rng = np.random.default_rng(2)
+    Image.fromarray(
+        rng.integers(0, 255, (60, 80, 3), dtype=np.uint8)
+    ).save(src)
+
+    client.calls.clear()
+    miss = handler.process_image("w_40,o_png", src)
+    assert not miss.from_cache
+    assert client.calls == ["get", "put", "head"]  # fetch-miss, write, stamp
+    assert miss.modified_at == _s3_now().timestamp()
+
+    client.calls.clear()
+    hit = handler.process_image("w_40,o_png", src)
+    assert hit.from_cache
+    assert client.calls == ["get"]  # ONE round trip serves the hit
+    assert hit.modified_at == _s3_now().timestamp()
