@@ -39,7 +39,11 @@ from flyimg_tpu.exceptions import (
     UnsupportedMediaException,
 )
 from flyimg_tpu.service.handler import ImageHandler
-from flyimg_tpu.service.response import image_headers
+from flyimg_tpu.service.response import (
+    NOT_MODIFIED_HEADERS,
+    image_headers,
+    is_not_modified,
+)
 from flyimg_tpu.storage import make_storage
 
 # config-overridable route patterns (reference config/routes.yml); 'home'
@@ -253,6 +257,13 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
         headers = image_headers(
             result, params.by_key("header_cache_days", 365)
         )
+        if is_not_modified(request.headers, headers):
+            return web.Response(
+                status=304,
+                headers={
+                    k: headers[k] for k in NOT_MODIFIED_HEADERS if k in headers
+                },
+            )
         return web.Response(body=result.content, headers=headers)
 
     async def path(request: web.Request) -> web.Response:

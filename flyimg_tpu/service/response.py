@@ -30,6 +30,17 @@ def image_headers(result: ProcessedImage, header_cache_days: int) -> Dict[str, s
     headers = dict(SECURITY_HEADERS)
     headers["Content-Type"] = result.spec.mime
     headers["Content-Disposition"] = f'inline;filename="{result.spec.name}"'
+    # ETag = content-addressed name (md5 of option values + source) PLUS
+    # the stored artifact's mtime: the name alone identifies the REQUEST,
+    # not the bytes — an rf_1 refresh rewrites new bytes under the same
+    # name, and the mtime component is what mints a fresh validator then
+    # (otherwise revalidating CDNs would 304 into stale bytes for up to
+    # header_cache_days). The reference sends validators but never
+    # answers 304s; conditional revalidation is pure bandwidth savings.
+    if result.modified_at is not None:
+        headers["ETag"] = f'"{result.spec.name}-{int(result.modified_at)}"'
+    else:
+        headers["ETag"] = f'"{result.spec.name}"'
 
     refresh = result.options.wants_refresh()
     if refresh:
@@ -59,3 +70,32 @@ def image_headers(result: ProcessedImage, header_cache_days: int) -> Dict[str, s
         usegmt=True,
     )
     return headers
+
+
+# headers a 304 must carry so caches can refresh stored metadata (RFC 9110
+# section 15.4.5); body and entity headers stay home
+NOT_MODIFIED_HEADERS = ("ETag", "Cache-Control", "Expires", "Last-Modified")
+
+
+def is_not_modified(request_headers, response_headers: Dict[str, str]) -> bool:
+    """Did the client's conditional validators match? If-None-Match wins
+    over If-Modified-Since (RFC 9110 section 13.2.2); debug/no-cache
+    responses (rf_1) never shortcut — the client asked for a recompute."""
+    if "no-cache" in response_headers.get("Cache-Control", ""):
+        return False
+    etag = response_headers.get("ETag", "")
+    inm = request_headers.get("If-None-Match", "")
+    if inm and etag:
+        tags = [t.strip().removeprefix("W/") for t in inm.split(",")]
+        return "*" in tags or etag in tags
+    ims = request_headers.get("If-Modified-Since", "")
+    last_mod = response_headers.get("Last-Modified", "")
+    if ims and last_mod:
+        try:
+            return (
+                email.utils.parsedate_to_datetime(last_mod)
+                <= email.utils.parsedate_to_datetime(ims)
+            )
+        except (TypeError, ValueError):
+            return False
+    return False

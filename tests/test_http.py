@@ -105,6 +105,41 @@ def test_last_modified_tracks_stored_artifact(tmp_path, source_png):
     ).timestamp() == int(os.path.getmtime(stored))
 
 
+def test_conditional_requests_get_304(tmp_path, source_png):
+    """ETag (the content-addressed name) + If-None-Match / Last-Modified +
+    If-Modified-Since answer 304 with no body — revalidation never re-reads
+    or re-serves the bytes (beyond-reference: flyimg sends validators but
+    always re-serves 200s)."""
+    path = f"/upload/w_32,o_png/{source_png}"
+    _, h1, body1 = _request(tmp_path, path)
+    etag = h1["Etag"]  # aiohttp title-cases header names on the wire
+    assert etag.startswith('"') and len(body1) > 0
+
+    status, h2, body2 = _request(
+        tmp_path, path, headers={"If-None-Match": etag}
+    )
+    assert status == 304 and body2 == b""
+    assert h2["Etag"] == etag  # 304 carries validators (RFC 9110)
+
+    status, _, body3 = _request(
+        tmp_path, path, headers={"If-Modified-Since": h1["Last-Modified"]}
+    )
+    assert status == 304 and body3 == b""
+
+    status, _, body4 = _request(
+        tmp_path, path, headers={"If-None-Match": '"nope"'}
+    )
+    assert status == 200 and body4 == body1
+
+    # rf_1 is an explicit recompute: conditionals never shortcut it
+    status, _, body5 = _request(
+        tmp_path,
+        f"/upload/w_32,o_png,rf_1/{source_png}",
+        headers={"If-None-Match": etag},
+    )
+    assert status == 200 and len(body5) > 0
+
+
 def test_upload_webp_negotiation(tmp_path, source_png):
     status, headers, _ = _request(
         tmp_path,
@@ -257,3 +292,23 @@ def test_compilation_cache_configured(tmp_path):
         _run(cleanup())
         for name, value in saved.items():
             jax.config.update(name, value)
+
+
+def test_refresh_mints_new_etag(tmp_path, source_png):
+    """The ETag folds in the stored artifact's mtime: an rf_1 rewrite of
+    the SAME name must produce a different validator, or revalidating
+    CDNs would 304 into stale bytes after the content changed."""
+    import time
+
+    path = f"/upload/w_32,o_png/{source_png}"
+    _, h1, _ = _request(tmp_path, path)
+    time.sleep(1.1)  # mtime + HTTP-date are second-granular
+    _, h2, _ = _request(tmp_path, f"/upload/w_32,o_png,rf_1/{source_png}")
+    _, h3, _ = _request(tmp_path, path)  # post-refresh cache hit
+    assert h2["Etag"] != h1["Etag"]
+    assert h3["Etag"] == h2["Etag"]  # stable again after the rewrite
+    # the old validator no longer matches -> full 200, fresh bytes
+    status, _, body = _request(
+        tmp_path, path, headers={"If-None-Match": h1["Etag"]}
+    )
+    assert status == 200 and len(body) > 0
