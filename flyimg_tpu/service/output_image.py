@@ -67,6 +67,10 @@ class OutputSpec:
     mime: str
     command_repr: str = ""          # rf_1 debug header (plan repr here)
     identify_repr: str = ""
+    # o_auto: the body depends on the request's Accept header (webp
+    # negotiation), so responses must carry `Vary: Accept` or a shared
+    # cache would serve one client's variant to every client
+    negotiated: bool = False
 
     @property
     def is_gif(self) -> bool:
@@ -83,9 +87,8 @@ def resolve_output(
     """Build the output spec; name layout matches OutputImage.php:50-66
     (options-hash, then '-{page}' for PDFs, '-{time-sans-punct}' for video,
     then '.{ext}')."""
-    extension = negotiate_extension(
-        str(options.extract_key("output") or "auto"), source_mime, accepts_webp
-    )
+    requested = str(options.extract_key("output") or "auto")
+    extension = negotiate_extension(requested, source_mime, accepts_webp)
     name = options.hashed_options_as_string(image_url)
     if source_mime == PDF_MIME:
         name += f"-{options.get('page_number', 1)}"
@@ -94,5 +97,6 @@ def resolve_output(
         name += "-" + time_spec.replace(".", "").replace(":", "")
     name += f".{extension}"
     return OutputSpec(
-        name=name, extension=extension, mime=EXT_TO_MIME[extension]
+        name=name, extension=extension, mime=EXT_TO_MIME[extension],
+        negotiated=requested == "auto",
     )

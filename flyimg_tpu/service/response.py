@@ -41,6 +41,10 @@ def image_headers(result: ProcessedImage, header_cache_days: int) -> Dict[str, s
         headers["ETag"] = f'"{result.spec.name}-{int(result.modified_at)}"'
     else:
         headers["ETag"] = f'"{result.spec.name}"'
+    if result.spec.negotiated:
+        # o_auto bodies depend on the Accept header (webp negotiation);
+        # without Vary a shared cache serves one client's variant to all
+        headers["Vary"] = "Accept"
 
     refresh = result.options.wants_refresh()
     if refresh:
@@ -74,7 +78,9 @@ def image_headers(result: ProcessedImage, header_cache_days: int) -> Dict[str, s
 
 # headers a 304 must carry so caches can refresh stored metadata (RFC 9110
 # section 15.4.5); body and entity headers stay home
-NOT_MODIFIED_HEADERS = ("ETag", "Cache-Control", "Expires", "Last-Modified")
+NOT_MODIFIED_HEADERS = (
+    "ETag", "Cache-Control", "Expires", "Last-Modified", "Vary",
+)
 
 
 def is_not_modified(request_headers, response_headers: Dict[str, str]) -> bool:

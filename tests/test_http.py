@@ -148,6 +148,14 @@ def test_upload_webp_negotiation(tmp_path, source_png):
     )
     assert status == 200
     assert headers["Content-Type"] == "image/webp"
+    # Accept decided the body -> shared caches must key on it
+    assert headers["Vary"] == "Accept"
+
+    # explicit output format: no negotiation, no Vary
+    status, headers, _ = _request(
+        tmp_path, f"/upload/w_20,o_png/{source_png}"
+    )
+    assert status == 200 and "Vary" not in headers
 
 
 def test_upload_refresh_debug_headers(tmp_path, source_png):
