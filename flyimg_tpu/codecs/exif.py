@@ -13,21 +13,29 @@ import struct
 import numpy as np
 
 
-def jpeg_orientation(data: bytes) -> int:
-    """EXIF orientation 1..8 (1 = upright) from JPEG bytes; 1 on any parse
-    failure."""
+# one scan budget for BOTH the orientation read and the st_0 metadata
+# graft: if they differed, pixels could be left unrotated while the
+# carried-over EXIF claims orientation 1
+_SCAN_LIMIT = 4 * 1024 * 1024
+
+
+def _find_exif_app1(data: bytes):
+    """(segment_offset, segment_length, tiff_entry_offset_of_0x0112 or -1,
+    endian) of the first EXIF APP1, or None. The single JPEG marker walk +
+    TIFF/IFD0 parse shared by every EXIF reader here — one parser, one
+    scan limit, no drift."""
     try:
         i = 2
-        n = min(len(data), 256 * 1024)
+        n = min(len(data), _SCAN_LIMIT)
         while i + 4 < n:
             if data[i] != 0xFF:
-                return 1
+                return None
             marker = data[i + 1]
             if marker == 0xD8:
                 i += 2
                 continue
             if marker in (0xDA, 0xD9):  # start of scan / end
-                return 1
+                return None
             seglen = struct.unpack(">H", data[i + 2 : i + 4])[0]
             if marker == 0xE1 and data[i + 4 : i + 10] == b"Exif\x00\x00":
                 tiff = i + 10
@@ -36,23 +44,68 @@ def jpeg_orientation(data: bytes) -> int:
                 elif data[tiff : tiff + 2] == b"MM":
                     endian = ">"
                 else:
-                    return 1
-                (ifd_off,) = struct.unpack(endian + "I", data[tiff + 4 : tiff + 8])
+                    return None
+                (ifd_off,) = struct.unpack(
+                    endian + "I", data[tiff + 4 : tiff + 8]
+                )
                 ifd = tiff + ifd_off
                 (count,) = struct.unpack(endian + "H", data[ifd : ifd + 2])
                 for k in range(count):
                     entry = ifd + 2 + 12 * k
-                    (tag,) = struct.unpack(endian + "H", data[entry : entry + 2])
+                    (tag,) = struct.unpack(
+                        endian + "H", data[entry : entry + 2]
+                    )
                     if tag == 0x0112:
-                        (value,) = struct.unpack(
-                            endian + "H", data[entry + 8 : entry + 10]
-                        )
-                        return value if 1 <= value <= 8 else 1
-                return 1
+                        return i, seglen, entry, endian
+                return i, seglen, -1, endian
             i += 2 + seglen
-        return 1
+        return None
     except (struct.error, IndexError):
+        return None
+
+
+def jpeg_orientation(data: bytes) -> int:
+    """EXIF orientation 1..8 (1 = upright) from JPEG bytes; 1 on any parse
+    failure."""
+    found = _find_exif_app1(data)
+    if found is None or found[2] < 0:
         return 1
+    _, _, entry, endian = found
+    (value,) = struct.unpack(endian + "H", data[entry + 8 : entry + 10])
+    return value if 1 <= value <= 8 else 1
+
+
+def extract_app1(data: bytes) -> bytes | None:
+    """The source JPEG's EXIF APP1 segment (marker + length + payload),
+    with its orientation tag rewritten to 1 — the pipeline bakes the
+    rotation into pixels, so carried-over metadata must not re-rotate.
+    None when absent/unparseable. Powers reference `st_0` semantics:
+    without -strip, ImageMagick preserves source metadata
+    (ImageProcessor.php:97-99); a decode-to-raw-pixels pipeline must
+    graft it back explicitly."""
+    found = _find_exif_app1(data)
+    if found is None:
+        return None
+    i, seglen, entry, endian = found
+    seg = bytearray(data[i : i + 2 + seglen])
+    if entry >= 0:
+        rel = entry - i  # entry offset inside the copied segment
+        seg[rel + 8 : rel + 10] = struct.pack(endian + "H", 1)
+    return bytes(seg)
+
+
+def inject_app1(jpeg: bytes, app1: bytes) -> bytes:
+    """Insert an APP1 segment into encoded JPEG bytes, after SOI and any
+    APP0/JFIF segment (the canonical position). Returns the input
+    unchanged when it doesn't look like a JPEG."""
+    if jpeg[:2] != b"\xff\xd8":
+        return jpeg
+    pos = 2
+    # skip existing APP0 (JFIF) so APP1 lands in its standard slot
+    while pos + 4 <= len(jpeg) and jpeg[pos] == 0xFF and jpeg[pos + 1] == 0xE0:
+        (seglen,) = struct.unpack(">H", jpeg[pos + 2 : pos + 4])
+        pos += 2 + seglen
+    return jpeg[:pos] + app1 + jpeg[pos:]
 
 
 def apply_orientation(rgb: np.ndarray, orientation: int) -> np.ndarray:

@@ -25,6 +25,7 @@ import numpy as np
 
 from flyimg_tpu.appconfig import AppParameters
 from flyimg_tpu.codecs import decode, encode, media_info
+from flyimg_tpu.codecs.exif import extract_app1, inject_app1
 from flyimg_tpu.exceptions import ServiceUnavailableException
 from flyimg_tpu.ops.compose import run_plan
 from flyimg_tpu.service.input_source import load_source
@@ -477,6 +478,19 @@ class ImageHandler:
                 strip=options.truthy("strip"),
                 alpha=alpha,
             )
+        # st_0: the reference preserves source metadata when -strip is off
+        # (ImageProcessor.php:97-99); raw-pixel decode loses it, so graft
+        # the source EXIF back (orientation reset to 1 — already baked
+        # into the pixels) for jpeg->jpeg outputs
+        if (
+            not options.truthy("strip")
+            and spec.extension == "jpg"
+            and decoded.mime == "image/jpeg"
+            and len(out_frames) == 1
+        ):
+            app1 = extract_app1(data)
+            if app1 is not None:
+                content = inject_app1(content, app1)
         timings["encode"] = time.perf_counter() - t
 
         # rf_1 debug header payload (reference `identify` line via the

@@ -654,3 +654,27 @@ def test_tiled_firehose_accepts_indivisible_height(tmp_path):
     assert (
         metrics.summary().get("flyimg_tiled_resamples_total") == 1.0
     ), "did not take the tiled path"
+
+
+def test_st0_preserves_source_exif(env):
+    """Reference -strip semantics: st_1 (default) drops metadata, st_0
+    keeps it (ImageProcessor.php:97-99). The carried-over EXIF has its
+    orientation reset to 1 — the rotation is baked into the pixels."""
+    handler, _, tmp = env
+    rng = np.random.default_rng(11)
+    img = Image.fromarray(rng.integers(0, 255, (60, 80, 3), dtype=np.uint8))
+    exif = img.getexif()
+    exif[0x0112] = 6          # orientation
+    exif[0x010F] = "CamCo"    # Make
+    src = str(tmp / "meta.jpg")
+    img.save(src, "JPEG", quality=92, exif=exif)
+
+    kept = handler.process_image("w_40,st_0,o_jpg", src)
+    out = Image.open(io.BytesIO(kept.content))
+    tags = out.getexif()
+    assert tags.get(0x010F) == "CamCo"
+    assert tags.get(0x0112) == 1  # orientation reset, pixels already upright
+    assert out.size == (40, 53)   # 80x60 oriented to 60x80, fit to w_40
+
+    stripped = handler.process_image("w_40,o_jpg", src)  # st_1 default
+    assert dict(Image.open(io.BytesIO(stripped.content)).getexif()) == {}
