@@ -1,0 +1,193 @@
+"""Per-operator device throughput: the compute-path surface behind the
+single bench.py headline.
+
+Measures each device operator family as batched steady-state launches —
+crop-fill resample, fit resample, static-extent rotate, separable
+gaussian blur, unsharp, grayscale, monochrome dither, and the smart-crop
+saliency+scoring pass (lax.scan amortizes dispatch exactly like bench.py;
+see its docstring for why that models real-hardware dispatch overlap).
+
+Usage:  python benchmarks/bench_ops.py [--batch 256] [--scan 10] [--out f.json]
+Writes one JSON document {backend, batch, results: [{op, images_per_sec}]}.
+CPU backends shrink sizes to smoke-test the harness itself. Backend init
+reuses bench.py's probe/retry/CPU-fallback so a dead TPU tunnel yields a
+CPU document instead of an in-process hang.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _steady_state(fn, args, batch: int, scan: int, launches: int = 4):
+    """Median images/sec of `fn(*args)` run `scan` times per device launch
+    (carry-xor defeats LICM/CSE the same way bench.py does)."""
+    import jax
+    import jax.numpy as jnp
+
+    def body(carry, _):
+        zero = jnp.isnan(carry).astype(jnp.uint8)
+        first = args[0] ^ zero
+        out = fn(first, *args[1:])
+        if isinstance(out, tuple):
+            acc = sum(o.astype(jnp.float32).sum() for o in out)
+        else:
+            acc = out.astype(jnp.float32).sum()
+        return carry + acc, None
+
+    @jax.jit
+    def launch():
+        acc, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=scan)
+        return acc
+
+    jax.block_until_ready(launch())
+    times = []
+    for _ in range(launches):
+        t = time.perf_counter()
+        jax.block_until_ready(launch())
+        times.append(time.perf_counter() - t)
+    return batch / (float(np.median(times)) / scan)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--scan", type=int, default=10)
+    ap.add_argument("--out", default=None)
+    ns = ap.parse_args()
+
+    from flyimg_tpu.parallel.mesh import ensure_env_platform
+
+    # honor JAX_PLATFORMS=cpu before the first device query (this
+    # environment's sitecustomize otherwise overrides it; see mesh.py)
+    ensure_env_platform()
+
+    # probe the backend out-of-process with CPU fallback (bench.py's
+    # hardening): a dead TPU tunnel can HANG in-process client creation
+    from bench import _init_backend
+
+    backend = _init_backend()
+
+    import jax
+    import jax.numpy as jnp
+
+    from flyimg_tpu.ops.compose import make_program_fn, plan_layout
+    from flyimg_tpu.spec.options import OptionsBag
+    from flyimg_tpu.spec.plan import build_plan
+    batch, scan = ns.batch, ns.scan
+    src = 512
+    if backend != "tpu":  # CPU smoke: harness correctness, not numbers
+        batch, scan, src = 8, 2, 128
+
+    rng = np.random.default_rng(0)
+    images = jax.device_put(
+        rng.integers(0, 255, (batch, src, src, 3), dtype=np.uint8)
+    )
+
+    def vmapped(options: str):
+        """One plan drives everything: device program, resample output
+        shape (derived, never hand-synced), and traced geometry scalars."""
+        plan = build_plan(OptionsBag(options), src, src)
+        layout = plan_layout(plan)
+        needs_resample = (
+            plan.resize_to is not None
+            or plan.extent is not None
+            or plan.extract is not None
+        )
+        out_shape = layout.resample_out if needs_resample else None
+        single = make_program_fn(
+            out_shape, layout.pad_canvas, layout.pad_offset,
+            plan.device_plan(),
+        )
+        n = images.shape[0]
+        in_true = jnp.full((n, 2), float(src), jnp.float32)
+        span_y = jnp.tile(jnp.asarray([layout.span_y], jnp.float32), (n, 1))
+        span_x = jnp.tile(jnp.asarray([layout.span_x], jnp.float32), (n, 1))
+        out_true = jnp.tile(
+            jnp.asarray([layout.out_true], jnp.float32), (n, 1)
+        )
+        fn = jax.vmap(single)
+        return lambda imgs: fn(imgs, in_true, span_y, span_x, out_true)
+
+    half = src // 2
+    cases = [
+        ("crop_fill_resample", vmapped(f"w_{half + 44},h_{half - 6},c_1")),
+        ("fit_resample", vmapped(f"w_{half}")),
+        ("rotate_45", vmapped("r_45")),
+        ("gaussian_blur", vmapped("blr_2x1")),
+        ("unsharp", vmapped("unsh_0.25x0.25+8+0.065")),
+        ("grayscale", vmapped("clsp_Gray")),
+        ("monochrome_dither", vmapped("mnchr_1")),
+    ]
+
+    results = []
+    for name, fn in cases:
+        try:
+            rate = _steady_state(fn, (images,), batch, scan)
+            results.append({"op": name, "images_per_sec": round(rate, 1)})
+            print(f"{name:22s} {rate:12.1f} img/s", file=sys.stderr)
+        except Exception as exc:  # record, keep measuring the rest
+            results.append({"op": name, "error": str(exc)[:200]})
+            print(f"{name:22s} ERROR {exc}", file=sys.stderr)
+
+    # smart-crop saliency+scoring on the post-resize shape (the bench.py
+    # second stage), measured standalone
+    try:
+        from flyimg_tpu.models.smartcrop import (
+            analyse_features,
+            importance_kernel,
+            weighted_field,
+        )
+
+        out_h, out_w = (250, 300) if backend == "tpu" else (64, 96)
+        fields = jax.device_put(
+            rng.integers(0, 255, (batch, out_h, out_w, 3), dtype=np.uint8)
+        )
+        kernel = jnp.asarray(
+            importance_kernel(out_w / 2.0, out_h / 2.0)
+        )
+
+        def saliency(imgs):
+            weighted = weighted_field(jax.vmap(analyse_features)(imgs))
+            inp = weighted[..., None]
+            ker = kernel[:, :, None, None]
+            dn = jax.lax.conv_dimension_numbers(
+                inp.shape, ker.shape, ("NHWC", "HWIO", "NHWC")
+            )
+            return jax.lax.conv_general_dilated(
+                inp, ker, (8, 8), "VALID", dimension_numbers=dn
+            )[..., 0]
+
+        rate = _steady_state(saliency, (fields,), batch, scan)
+        results.append(
+            {"op": "saliency_score", "images_per_sec": round(rate, 1)}
+        )
+        print(f"{'saliency_score':22s} {rate:12.1f} img/s", file=sys.stderr)
+    except Exception as exc:
+        results.append({"op": "saliency_score", "error": str(exc)[:200]})
+
+    doc = {
+        "backend": backend,
+        "batch": batch,
+        "scan": scan,
+        "src_size": src,
+        "results": results,
+    }
+    text = json.dumps(doc, indent=1)
+    if ns.out:
+        with open(ns.out, "w") as fh:
+            fh.write(text + "\n")
+    print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
