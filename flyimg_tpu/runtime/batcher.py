@@ -350,16 +350,29 @@ class BatchController:
         total_pending = sum(len(g.members) for g in self._groups.values())
         best = None
         best_score = None
+        starving = None
+        starving_age = 0.0
         for key, group in list(self._groups.items()):
             if not group.members:
                 self._groups.pop(key, None)
                 continue
             if not self._group_ready(group, now, total_pending):
                 continue
+            age = now - group.members[0].enqueued_at
+            # starvation guard: full groups normally win (throughput), but
+            # under sustained full-batch traffic that would strand a small
+            # group forever. The floor keeps this a LAST resort: batch
+            # service time routinely exceeds a few deadlines, so a bare
+            # 4x-deadline trigger would fire on nearly every pop under
+            # load and collapse the fullest-group policy into oldest-first
+            if age >= max(4.0 * self.deadline_s, 0.25) and age > starving_age:
+                starving, starving_age = key, age
             full = len(group.members) >= self.max_batch
             score = (1 if full else 0, len(group.members))
             if best_score is None or score > best_score:
                 best, best_score = key, score
+        if starving is not None:
+            best = starving
         if best is None:
             return None
         group = self._groups[best]

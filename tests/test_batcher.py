@@ -318,3 +318,44 @@ def test_rotate_with_conv_postop_stays_exact(controller):
     plan = _plan("r_45,blr_2", 300, 200)
     out = controller.submit(img, plan).result(timeout=120)
     np.testing.assert_array_equal(out, run_plan(img, plan))
+
+
+def test_starving_group_preempts_full_groups():
+    """A group 4x past its deadline preempts the fullest-group policy:
+    under sustained full-batch traffic a lone odd-shaped request must not
+    be starved indefinitely. Truly deterministic: the executor thread is
+    PARKED (subclass no-ops _run), so the test thread owns pop + execute
+    serially — no race with the real executor, no timing dependence."""
+    import time as _time
+
+    class _ParkedExecutor(BatchController):
+        def _run(self):  # executor parked: pop policy driven by the test
+            return
+
+    ctl = _ParkedExecutor(max_batch=4, deadline_ms=20.0, lone_flush=False)
+    try:
+        img_a = make_test_image(200, 100, seed=1)
+        plan_a = _plan("w_50,o_jpg", 200, 100)
+        img_b = make_test_image(100, 200, seed=2)
+        plan_b = _plan("w_40,o_jpg", 100, 200)
+        futs = [ctl.submit(img_a, plan_a) for _ in range(4)]  # full group
+        fut_b = ctl.submit(img_b, plan_b)                     # lone member
+        with ctl._lock:
+            # backdate the lone group past the starvation floor; the full
+            # group stays fresh and would otherwise win the pop
+            for group in ctl._groups.values():
+                if len(group.members) == 1:
+                    group.members[0].enqueued_at = _time.monotonic() - 2.0
+            popped = ctl._pop_ready_group()
+        assert popped is not None and len(popped.members) == 1
+        ctl._execute(popped)
+        assert fut_b.result(timeout=120).shape[1] == 40
+        # next pop serves the full group as usual
+        with ctl._lock:
+            rest = ctl._pop_ready_group()
+        assert rest is not None and len(rest.members) == 4
+        ctl._execute(rest)
+        for f in futs:
+            assert f.result(timeout=120).shape[1] == 50
+    finally:
+        ctl.close()
