@@ -1,0 +1,51 @@
+"""Offline bulk runner: a directory through the batch runtime
+(BASELINE.md firehose-workload driver)."""
+
+import json
+import os
+
+import numpy as np
+from PIL import Image
+
+from flyimg_tpu.bulk import bulk_process, main
+
+
+def _make_dir(tmp_path, n=6):
+    src = tmp_path / "src"
+    src.mkdir()
+    rng = np.random.default_rng(0)
+    for i in range(n):
+        Image.fromarray(
+            rng.integers(0, 255, (200 + 10 * (i % 3), 300, 3), dtype=np.uint8)
+        ).save(src / f"img{i}.png")
+    return src
+
+
+def test_bulk_process_directory(tmp_path):
+    src = _make_dir(tmp_path)
+    out = tmp_path / "out"
+    summary = bulk_process(
+        str(src), str(out), "w_100,h_80,c_1", out_format="jpg", workers=4
+    )
+    assert summary["images"] == 6 and summary["failed"] == 0
+    outs = sorted(os.listdir(out))
+    assert outs == [f"img{i}.jpg" for i in range(6)]
+    for name in outs:
+        im = Image.open(out / name)
+        assert im.size == (100, 80)
+    # same-geometry files shared vmapped launches
+    assert summary["batches"] <= summary["images"]
+
+
+def test_bulk_cli_and_bad_file(tmp_path, capsys):
+    src = _make_dir(tmp_path, n=3)
+    (src / "broken.jpg").write_bytes(b"not an image")
+    out = tmp_path / "o2"
+    rc = main([
+        "--src", str(src), "--out", str(out),
+        "--options", "w_50", "--format", "png", "--workers", "2",
+    ])
+    assert rc == 1  # the broken file is reported as failed
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["images"] == 3 and summary["failed"] == 1
+    assert sorted(os.listdir(out)) == [f"img{i}.png" for i in range(3)]
