@@ -389,6 +389,12 @@ def main(argv=None) -> int:
     srv.add_argument("--host", default="0.0.0.0")
     srv.add_argument("--port", type=int, default=8080)
     srv.add_argument("--params", default=None)
+    prn = sub.add_parser(
+        "prune",
+        help="evict least-recently-modified cached outputs to a size budget",
+    )
+    prn.add_argument("--max-bytes", type=int, required=True)
+    prn.add_argument("--params", default=None)
     args = parser.parse_args(argv)
 
     params = (
@@ -400,6 +406,19 @@ def main(argv=None) -> int:
         from flyimg_tpu.service.security import SecurityHandler
 
         print(SecurityHandler(params).encrypt(args.payload))
+        return 0
+    if args.cmd == "prune":
+        import json as _json
+
+        storage = make_storage(params)
+        if not hasattr(storage, "prune"):
+            print(
+                f"{type(storage).__name__} does not support prune "
+                "(use a bucket lifecycle policy for S3)",
+                file=sys.stderr,
+            )
+            return 1
+        print(_json.dumps(storage.prune(args.max_bytes)))
         return 0
     if args.cmd == "serve":
         from flyimg_tpu.parallel.dist import initialize_multihost

@@ -70,3 +70,42 @@ class LocalStorage(Storage):
     def public_url(self, name: str, request_base: Optional[str] = None) -> str:
         base = os.environ.get("HOSTNAME_URL") or request_base or ""
         return f"{base.rstrip('/')}/{UPLOAD_WEB_DIR}{name}"
+
+    def prune(self, max_bytes: int) -> dict:
+        """Evict least-recently-modified artifacts until the store fits
+        ``max_bytes`` (the derived-output cache grows unboundedly in both
+        this framework and the reference — every entry is recomputable, so
+        eviction is always safe). Strict age cutoff: newest-first
+        accumulation stops at the first entry that would overflow the
+        budget, and that entry plus everything older is evicted — so every
+        kept artifact is newer than every evicted one. Returns
+        {kept, deleted, bytes} where ``bytes`` is what actually remains on
+        disk (files that failed to delete are counted as kept)."""
+        entries = []
+        with os.scandir(self.root) as it:
+            for entry in it:
+                if not entry.is_file() or entry.name.endswith(".part"):
+                    continue
+                try:
+                    st = entry.stat()
+                except OSError:  # deleted concurrently (server/other prune)
+                    continue
+                entries.append((st.st_mtime, st.st_size, entry.path))
+        entries.sort(reverse=True)  # newest first
+        total = 0
+        kept = 0
+        deleted = 0
+        evicting = False
+        for _mtime, size, path in entries:
+            if not evicting and total + size <= max_bytes:
+                total += size
+                kept += 1
+                continue
+            evicting = True
+            try:
+                os.remove(path)
+                deleted += 1
+            except OSError:  # still on disk: report it honestly
+                kept += 1
+                total += size
+        return {"kept": kept, "deleted": deleted, "bytes": total}

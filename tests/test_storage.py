@@ -308,3 +308,57 @@ def test_handler_s3_round_trips_per_request(s3, tmp_path):
     assert hit.from_cache
     assert client.calls == ["get"]  # ONE round trip serves the hit
     assert hit.modified_at == _s3_now().timestamp()
+
+
+def test_local_prune_evicts_lru(local, tmp_path):
+    """prune() keeps the newest artifacts that fit the budget and deletes
+    the least-recently-modified remainder (all entries are recomputable
+    derived outputs, so eviction is always safe)."""
+    import os
+    import time
+
+    for i in range(5):
+        local.write(f"art{i}.jpg", bytes(100))
+        # distinct mtimes, oldest first
+        stamp = time.time() - (5 - i) * 100
+        os.utime(local._path(f"art{i}.jpg"), (stamp, stamp))
+    (tmp_path / "up" / "x.part").write_bytes(b"tmp")  # in-flight: untouched
+
+    summary = local.prune(250)
+    assert summary == {"kept": 2, "deleted": 3, "bytes": 200}
+    kept = sorted(os.listdir(tmp_path / "up"))
+    assert kept == ["art3.jpg", "art4.jpg", "x.part"]
+
+
+def test_prune_cli(tmp_path, capsys):
+    import json
+
+    from flyimg_tpu.service.app import main
+
+    up = tmp_path / "uploads"
+    params_yml = tmp_path / "p.yml"
+    params_yml.write_text(f"upload_dir: {up}\n")
+    up.mkdir()
+    for i in range(3):
+        (up / f"a{i}.jpg").write_bytes(bytes(10))
+    rc = main(["prune", "--max-bytes", "15", "--params", str(params_yml)])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["kept"] == 1 and out["deleted"] == 2
+
+
+def test_local_prune_strict_age_cutoff(local, tmp_path):
+    """A large recent file that overflows the budget evicts itself AND
+    everything older — kept entries are always newer than deleted ones
+    (no mixing where a hot large file dies while cold small files live)."""
+    import os
+    import time
+
+    sizes = [40, 50, 200]  # oldest..newest
+    for i, size in enumerate(sizes):
+        local.write(f"c{i}.jpg", bytes(size))
+        stamp = time.time() - (len(sizes) - i) * 100
+        os.utime(local._path(f"c{i}.jpg"), (stamp, stamp))
+    summary = local.prune(100)
+    # newest (200B) overflows immediately -> strict cutoff evicts all
+    assert summary == {"kept": 0, "deleted": 3, "bytes": 0}
