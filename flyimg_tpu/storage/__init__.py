@@ -2,7 +2,8 @@
 
 The Flysystem equivalent (reference src/Core/StorageProvider/): a tiny
 has/read/write/delete contract plus a public-URL formatter. Local disk and
-S3 (gated on boto3) are provided, matching the reference's two providers.
+S3 (gated on boto3) match the reference's two providers; GCS (gated on
+google-cloud-storage) is the TPU-deployment-native addition.
 """
 
 from flyimg_tpu.storage.base import Storage  # noqa: F401
@@ -17,4 +18,8 @@ def make_storage(params) -> "Storage":
         from flyimg_tpu.storage.s3 import S3Storage
 
         return S3Storage(params)
+    if system == "gcs":
+        from flyimg_tpu.storage.gcs import GCSStorage
+
+        return GCSStorage(params)
     return LocalStorage(params)

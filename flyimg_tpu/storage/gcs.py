@@ -1,0 +1,92 @@
+"""Google Cloud Storage backend, gated on google-cloud-storage.
+
+Beyond-reference (flyimg ships local + S3 only; SURVEY.md section 7 phase 6
+plans "S3/GCS"): TPU deployments live on GCP, where GCS is the natural
+shared store for the multi-host serving tier. Same validator contract as
+the S3 provider: write() returns the object's own stamp so miss responses
+and later cache hits carry the identical Last-Modified. Cache hits use the
+base-class fetch() (metadata GET + download — the GCS client does not
+surface object metadata from a media download, so unlike S3 there is no
+single-call path)."""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from flyimg_tpu.exceptions import MissingParamsException
+from flyimg_tpu.storage.base import Storage, StorageStat
+
+
+class GCSStorage(Storage):
+    def __init__(self, params) -> None:
+        conf = params.by_key("gcs", {}) or {}
+        self.bucket_name = conf.get("bucket_name", "")
+        if not self.bucket_name:
+            raise MissingParamsException(
+                "gcs storage selected but gcs.bucket_name is not set"
+            )
+        try:
+            from google.cloud import storage as gcs
+        except ImportError as exc:
+            raise MissingParamsException(
+                "gcs storage selected but google-cloud-storage is not "
+                "installed"
+            ) from exc
+        # project/credentials resolve via Application Default Credentials,
+        # the standard on GCP hosts (incl. TPU VMs)
+        self._client = gcs.Client(project=conf.get("project") or None)
+        self._bucket = self._client.bucket(self.bucket_name)
+
+    @staticmethod
+    def _is_not_found(exc: Exception) -> bool:
+        """Missing objects only (404); outages AND permission errors must
+        propagate (a miss triggers recompute+rewrite, so an error misread
+        as 'absent' is a silent cost amplification). Unlike S3, GCS never
+        answers a missing key with 403 — 403 strictly means permission
+        denied, so it propagates. Duck-typed on google-api-core
+        exceptions' ``code`` attribute so the import stays gated."""
+        return getattr(exc, "code", None) == 404
+
+    def has(self, name: str) -> bool:
+        try:
+            return self._bucket.blob(name).exists()
+        except Exception as exc:
+            if self._is_not_found(exc):
+                return False
+            raise
+
+    def read(self, name: str) -> bytes:
+        return self._bucket.blob(name).download_as_bytes()
+
+    def write(self, name: str, data: bytes) -> Optional[float]:
+        blob = self._bucket.blob(name)
+        blob.upload_from_string(data)
+        # upload_from_string refreshes blob metadata from the response:
+        # the object's OWN stamp, so hits serve the identical validator
+        updated = getattr(blob, "updated", None)
+        return updated.timestamp() if updated is not None else time.time()
+
+    def delete(self, name: str) -> None:
+        try:
+            self._bucket.blob(name).delete()
+        except Exception as exc:
+            if not self._is_not_found(exc):
+                raise
+
+    def stat(self, name: str) -> Optional[StorageStat]:
+        try:
+            blob = self._bucket.get_blob(name)
+        except Exception as exc:
+            if self._is_not_found(exc):
+                return None
+            raise
+        if blob is None:
+            return None
+        updated = getattr(blob, "updated", None)
+        return StorageStat(
+            mtime=updated.timestamp() if updated is not None else None
+        )
+
+    def public_url(self, name: str, request_base: Optional[str] = None) -> str:
+        return f"https://storage.googleapis.com/{self.bucket_name}/{name}"

@@ -362,3 +362,133 @@ def test_local_prune_strict_age_cutoff(local, tmp_path):
     summary = local.prune(100)
     # newest (200B) overflows immediately -> strict cutoff evicts all
     assert summary == {"kept": 0, "deleted": 3, "bytes": 0}
+
+
+# ---------------------------------------------------------------------------
+# GCS without Google Cloud (same strategy as the S3 fakes)
+# ---------------------------------------------------------------------------
+
+
+class _GcsNotFound(Exception):
+    code = 404
+
+
+class _FakeBlob:
+    def __init__(self, store, name):
+        self._store = store
+        self._name = name
+        self.updated = None
+
+    def exists(self):
+        return self._name in self._store
+
+    def upload_from_string(self, data):
+        if isinstance(data, str):
+            data = data.encode()
+        self._store[self._name] = data
+        self.updated = _s3_now()
+
+    def download_as_bytes(self):
+        if self._name not in self._store:
+            raise _GcsNotFound("404")
+        return self._store[self._name]
+
+    def delete(self):
+        if self._name not in self._store:
+            raise _GcsNotFound("404")
+        del self._store[self._name]
+
+
+class _FakeBucket:
+    def __init__(self):
+        self.store = {}
+
+    def blob(self, name):
+        return _FakeBlob(self.store, name)
+
+    def get_blob(self, name):
+        if name not in self.store:
+            return None
+        b = _FakeBlob(self.store, name)
+        b.updated = _s3_now()
+        return b
+
+
+@pytest.fixture()
+def gcs(monkeypatch):
+    bucket = _FakeBucket()
+    fake_storage = types.ModuleType("google.cloud.storage")
+    fake_storage.Client = lambda project=None: types.SimpleNamespace(
+        bucket=lambda name: bucket
+    )
+    fake_cloud = types.ModuleType("google.cloud")
+    fake_cloud.storage = fake_storage
+    fake_google = types.ModuleType("google")
+    fake_google.cloud = fake_cloud
+    monkeypatch.setitem(sys.modules, "google", fake_google)
+    monkeypatch.setitem(sys.modules, "google.cloud", fake_cloud)
+    monkeypatch.setitem(sys.modules, "google.cloud.storage", fake_storage)
+    params = AppParameters(
+        {"storage_system": "gcs", "gcs": {"bucket_name": "imgs"}}
+    )
+    return make_storage(params), bucket
+
+
+def test_gcs_missing_bucket_raises():
+    params = AppParameters({"storage_system": "gcs", "gcs": {}})
+    with pytest.raises(MissingParamsException):
+        make_storage(params)
+
+
+def test_gcs_roundtrip_fetch_stat(gcs):
+    storage, bucket = gcs
+    assert not storage.has("k.webp")
+    assert storage.stat("k.webp") is None
+    assert storage.fetch("k.webp") is None
+    wrote = storage.write("k.webp", b"payload")
+    assert wrote == _s3_now().timestamp()
+    assert storage.has("k.webp")
+    data, st = storage.fetch("k.webp")
+    assert data == b"payload" and st.mtime == _s3_now().timestamp()
+    assert storage.stat("k.webp").mtime == _s3_now().timestamp()
+    storage.delete("k.webp")
+    assert not storage.has("k.webp")
+    storage.delete("k.webp")  # idempotent via not-found discrimination
+
+
+def test_gcs_public_url(gcs):
+    storage, _ = gcs
+    assert (
+        storage.public_url("a.jpg")
+        == "https://storage.googleapis.com/imgs/a.jpg"
+    )
+
+
+def test_gcs_non_notfound_errors_propagate(gcs):
+    """Unlike S3, GCS 403 strictly means permission denied (it never
+    stands in for a missing key), so 403 AND outages propagate — neither
+    may read as a cache miss."""
+
+    class _Outage(Exception):
+        code = 503
+
+    class _Forbidden(Exception):
+        code = 403
+
+    storage, bucket = gcs
+
+    def boom(name):
+        raise _Outage("503")
+
+    bucket.get_blob = boom
+    with pytest.raises(_Outage):
+        storage.stat("k.webp")
+    with pytest.raises(_Outage):
+        storage.fetch("k.webp")
+
+    def deny(name):
+        raise _Forbidden("403")
+
+    bucket.get_blob = deny
+    with pytest.raises(_Forbidden):
+        storage.stat("k.webp")
