@@ -232,6 +232,47 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
 
     app.on_cleanup.append(_close_batcher)
 
+    # automatic cache budget: prune least-recently-modified outputs in the
+    # background when `cache_max_bytes` is set (local storage only — S3 /
+    # GCS deployments use bucket lifecycle policies)
+    cache_max = int(params.by_key("cache_max_bytes", 0) or 0)
+    # a non-positive interval disables the loop (and can never busy-spin)
+    prune_interval = float(params.by_key("cache_prune_interval_s", 300.0))
+    if cache_max > 0 and prune_interval > 0 and hasattr(storage, "prune"):
+
+        async def _prune_loop(app_):
+            import contextlib
+            import logging
+
+            loop = asyncio.get_running_loop()
+            log = logging.getLogger(__name__)
+
+            async def run():
+                while True:
+                    await asyncio.sleep(prune_interval)
+                    try:
+                        summary = await loop.run_in_executor(
+                            None, storage.prune, cache_max
+                        )
+                    except Exception as exc:
+                        # a transient scan error must not silently END
+                        # budget enforcement for the process lifetime
+                        log.warning("cache prune pass failed: %s", exc)
+                        continue
+                    if summary["deleted"]:
+                        metrics.counter(
+                            "flyimg_cache_pruned_total",
+                            "Cached outputs evicted by the size budget",
+                        ).inc(summary["deleted"])
+
+            task = asyncio.create_task(run())
+            yield
+            task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await task
+
+        app.cleanup_ctx.append(_prune_loop)
+
     def _accepts_webp(request: web.Request) -> bool:
         return "image/webp" in request.headers.get("Accept", "")
 

@@ -320,3 +320,37 @@ def test_refresh_mints_new_etag(tmp_path, source_png):
         tmp_path, path, headers={"If-None-Match": h1["Etag"]}
     )
     assert status == 200 and len(body) > 0
+
+
+def test_background_prune_enforces_cache_budget(tmp_path, source_png):
+    """With cache_max_bytes set, serve prunes the upload dir in the
+    background: old artifacts beyond the budget disappear without any
+    operator action."""
+    import asyncio
+    import os
+    import time
+
+    async def go():
+        app = make_app(
+            _params(
+                tmp_path,
+                cache_max_bytes=1,           # everything overflows
+                cache_prune_interval_s=0.2,
+            )
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            resp = await client.get(f"/upload/w_32,o_png/{source_png}")
+            assert resp.status == 200
+            # don't pre-assert the artifact exists: the pruner runs in a
+            # real executor thread and may already have evicted it
+            up = tmp_path / "uploads"
+            deadline = time.time() + 5
+            while time.time() < deadline and os.listdir(up):
+                await asyncio.sleep(0.1)
+            assert os.listdir(up) == []
+        finally:
+            await client.close()
+
+    _run(go())
