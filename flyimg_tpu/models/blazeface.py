@@ -17,7 +17,6 @@ which is what __graft_entry__.dryrun_multichip exercises.
 
 from __future__ import annotations
 
-import math
 from functools import partial
 from typing import Any, Dict, List, Optional, Tuple
 

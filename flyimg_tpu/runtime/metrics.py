@@ -20,7 +20,7 @@ from __future__ import annotations
 import math
 import threading
 import time
-from typing import Dict, Iterable, List, Optional, Tuple
+from typing import Dict, Iterable, List, Tuple
 
 # log-spaced latency buckets in seconds: 23 buckets, x1.8 apart,
 # 120us .. ~113s — covers device-batch latencies through cold compiles.

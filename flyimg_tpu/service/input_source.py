@@ -17,7 +17,6 @@ from __future__ import annotations
 import os
 import threading
 from dataclasses import dataclass
-from typing import Optional
 
 import httpx
 
