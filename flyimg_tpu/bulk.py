@@ -3,10 +3,13 @@
 The serving path processes one HTTP request at a time; the BASELINE
 workloads ("1k COCO batch resize", "4k->256 thumbnail firehose",
 BASELINE.md configs 1 and 4) are offline sweeps. This driver feeds every
-image in a directory through the same machinery serving uses — native
-DecodePool-backed decode on a host thread pool, one BatchController
-grouping frames into vmapped device launches, host encode — and writes
-outputs under the original file names.
+image in a directory through the handler's OWN transform pipeline
+(``ImageHandler.transform_bytes``) — native DecodePool-backed decode, one
+BatchController grouping frames into vmapped device launches, the full
+post-pass chain (smart-crop, face ops, alpha flatten, st_0 metadata
+graft), host encode — and writes outputs under the original file names.
+Because bulk and serving share one code path, the same options string
+produces the same bytes in both.
 
 Usage:
     python -m flyimg_tpu.bulk --src photos/ --out thumbs/ \
@@ -37,52 +40,86 @@ def bulk_process(
     out_format: str = "jpg",
     workers: int = 8,
     batcher=None,
-    quality: int = 90,
+    quality: Optional[int] = None,
 ) -> Dict[str, float]:
     """Transform every image under ``src_dir`` (non-recursive) with the
     URL-DSL ``options_str``; outputs land in ``out_dir`` as
     ``<stem>.<out_format>``. Returns the summary dict the CLI prints.
 
     Decode runs on ``workers`` threads (the native codec releases the
-    GIL); all frames funnel into ONE BatchController so concurrent files
-    with the same post-decode geometry share vmapped device launches —
-    identical machinery, identical numerics to serving."""
-    from flyimg_tpu.codecs import decode, encode
+    GIL); all frames funnel into ONE device BatchController plus one
+    host-codec controller — the same two-controller split serving uses —
+    so concurrent files with the same post-decode geometry share vmapped
+    device launches and JPEG decodes batch on the native pool.
+
+    ``--format`` governs the output container (there is no Accept header
+    to negotiate against); an ``o_`` key in ``options_str`` is ignored.
+    ``quality`` overrides the encode quality unless the options string
+    itself carries an explicit ``q_``."""
+    from flyimg_tpu.appconfig import AppParameters
+    from flyimg_tpu.models.faces import make_face_backend
     from flyimg_tpu.runtime.batcher import BatchController
+    from flyimg_tpu.service.handler import ImageHandler
+    from flyimg_tpu.service.output_image import EXT_TO_MIME, OutputSpec
     from flyimg_tpu.spec.options import OptionsBag
-    from flyimg_tpu.spec.plan import build_plan, decode_target_hint
 
     os.makedirs(out_dir, exist_ok=True)
     names = sorted(
         n for n in os.listdir(src_dir)
         if n.lower().endswith(IMAGE_EXTENSIONS)
     )
+    params = AppParameters()
     own_batcher = batcher is None
     if own_batcher:
-        batcher = BatchController()
+        # same tunables serving reads (service/app.py): an operator's
+        # batching config must mean the same thing in offline sweeps
+        batcher = BatchController(
+            max_batch=int(params.by_key("batch_max_size", 64)),
+            deadline_ms=float(params.by_key("batch_deadline_ms", 4.0)),
+        )
+    # host codec work on its own controller so JPEG-decode pool batches
+    # don't serialize against device launches (mirrors service/app.py)
+    codec_batcher = BatchController(
+        max_batch=int(params.by_key("decode_batch_max", 32)),
+        deadline_ms=float(params.by_key("decode_deadline_ms", 1.0)),
+    )
+    handler = ImageHandler(
+        storage=None,  # transform_bytes never touches storage
+        params=params,
+        batcher=batcher,
+        codec_batcher=codec_batcher,
+        face_backend=make_face_backend(
+            str(params.by_key("face_backend", "auto")),
+            params.by_key("face_checkpoint"),
+        ),
+    )
 
-    options = OptionsBag(options_str)
-    hint = decode_target_hint(options)
+    ext = "jpg" if out_format in ("jpg", "jpeg") else out_format
+    explicit_quality = any(
+        seg.startswith("q_") for seg in options_str.split(",")
+    )
     failed = 0
     t0 = time.perf_counter()
 
-    def run_one(name: str) -> Optional[str]:
+    def run_one(name: str) -> None:
         src = os.path.join(src_dir, name)
         with open(src, "rb") as fh:
             data = fh.read()
-        decoded = decode(data, target_hint=hint)
-        w, h = decoded.size
-        plan = build_plan(options, w, h)
-        out = batcher.submit(decoded.rgb, plan).result(timeout=600)
-        content = encode(out, out_format, quality=quality)
-        dst = os.path.join(
-            out_dir, os.path.splitext(name)[0] + f".{out_format}"
+        # fresh bag per file: plan building and the transform read options
+        # concurrently across worker threads, and some accessors mutate
+        options = OptionsBag(options_str)
+        if quality is not None and not explicit_quality:
+            options.set_option("quality", int(quality))
+        stem = os.path.splitext(name)[0]
+        spec = OutputSpec(
+            name=f"{stem}.{ext}", extension=ext, mime=EXT_TO_MIME[ext]
         )
+        content = handler.transform_bytes(data, options, spec)
+        dst = os.path.join(out_dir, f"{stem}.{ext}")
         tmp = dst + ".part"
         with open(tmp, "wb") as fh:
             fh.write(content)
         os.replace(tmp, dst)
-        return None
 
     try:
         with ThreadPoolExecutor(max_workers=workers) as pool:
@@ -97,6 +134,7 @@ def bulk_process(
         elapsed = time.perf_counter() - t0
         stats = batcher.stats()
     finally:
+        codec_batcher.close()
         if own_batcher:
             batcher.close()
 
@@ -119,7 +157,7 @@ def main(argv=None) -> int:
     ap.add_argument("--format", default="jpg",
                     choices=("jpg", "png", "webp", "gif"))
     ap.add_argument("--workers", type=int, default=8)
-    ap.add_argument("--quality", type=int, default=90)
+    ap.add_argument("--quality", type=int, default=None)
     ns = ap.parse_args(argv)
 
     from flyimg_tpu.parallel.mesh import ensure_env_platform

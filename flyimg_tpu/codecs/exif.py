@@ -56,7 +56,21 @@ def _find_exif_app1(data: bytes):
                         endian + "H", data[entry : entry + 2]
                     )
                     if tag == 0x0112:
-                        return i, seglen, entry, endian
+                        # IFD offsets are attacker-controlled: only hand the
+                        # entry back when its full 12 bytes lie inside BOTH
+                        # the buffer (jpeg_orientation unpacks entry+8..10)
+                        # and the APP1 segment (extract_app1 slice-assigns
+                        # into the copied segment — writing past it would
+                        # desync the declared length from the actual bytes).
+                        # Out-of-bounds ⇒ treat as "no orientation entry":
+                        # pixels stay unrotated AND the graft keeps the raw
+                        # tag bytes, so the two readers stay consistent.
+                        if (
+                            entry + 12 <= len(data)
+                            and entry + 12 <= i + 2 + seglen
+                        ):
+                            return i, seglen, entry, endian
+                        return i, seglen, -1, endian
                 return i, seglen, -1, endian
             i += 2 + seglen
         return None
@@ -87,6 +101,12 @@ def extract_app1(data: bytes) -> bytes | None:
     if found is None:
         return None
     i, seglen, entry, endian = found
+    if i + 2 + seglen > len(data):
+        # truncated file: the segment's declared length runs past EOF, so
+        # a copy would hold fewer bytes than it declares and downstream
+        # parsers of the grafted output would eat into the next marker —
+        # skip the graft entirely
+        return None
     seg = bytearray(data[i : i + 2 + seglen])
     if entry >= 0:
         rel = entry - i  # entry offset inside the copied segment

@@ -247,6 +247,23 @@ class ImageHandler:
 
     # ------------------------------------------------------------------
 
+    def transform_bytes(
+        self,
+        data: bytes,
+        options: OptionsBag,
+        spec: OutputSpec,
+        timings: Optional[Dict[str, float]] = None,
+    ) -> bytes:
+        """Public entry for offline callers (the bulk runner): the exact
+        cache-miss transform pipeline — decode, device program, smart-crop/
+        face post-passes, alpha flatten over bg_, st_0 metadata graft,
+        encode — with no storage or HTTP involved. Keeping bulk on this
+        single code path is what makes its outputs byte-identical to
+        serving for the same options."""
+        return self._process_new(
+            data, options, spec, {} if timings is None else timings
+        )
+
     def _tiled_or_none(self, frame: np.ndarray, plan: TransformPlan):
         """Run the H-sharded halo-exchange resample when it applies:
         a full-frame resample-only plan, a tall input divisible by the 'sp'

@@ -37,6 +37,7 @@ class S3Storage(Storage):
             aws_secret_access_key=self.secret_key,
             region_name=self.region,
         )
+        self._warned_403 = False
 
     @staticmethod
     def _is_not_found(exc: Exception) -> bool:
@@ -50,11 +51,17 @@ class S3Storage(Storage):
         response = getattr(exc, "response", None)
         if isinstance(response, dict):
             code = str(response.get("Error", {}).get("Code", ""))
-        # 403/AccessDenied is S3's documented answer for a MISSING key when
-        # credentials lack s3:ListBucket (a common least-privilege setup),
-        # so it must read as a miss; a genuinely broken credential set
-        # still surfaces typed at write() time when PutObject fails.
-        return code in ("404", "NoSuchKey", "NotFound", "403", "AccessDenied")
+        if code in ("404", "NoSuchKey", "NotFound"):
+            return True
+        # 403/AccessDenied is S3's documented answer for a MISSING key —
+        # on HeadObject AND GetObject — when credentials lack s3:ListBucket
+        # (a common least-privilege setup), so it must read as a miss on
+        # every probe; propagating would 500 every uncached request under
+        # that IAM shape. The cost: a genuinely denied read policy also
+        # presents as a permanent miss (recompute + rewrite forever), so
+        # fetch() logs the first swallowed GetObject 403 to give that
+        # misconfiguration an error signal.
+        return code in ("403", "AccessDenied")
 
     def has(self, name: str) -> bool:
         try:
@@ -102,6 +109,21 @@ class S3Storage(Storage):
             obj = self._client.get_object(Bucket=self.bucket, Key=name)
         except Exception as exc:
             if self._is_not_found(exc):
+                code = str(
+                    getattr(exc, "response", {}).get("Error", {}).get("Code", "")
+                ) if isinstance(getattr(exc, "response", None), dict) else ""
+                if code in ("403", "AccessDenied") and not self._warned_403:
+                    self._warned_403 = True
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "S3 GetObject on %r returned 403 — treated as a "
+                        "cache miss (least-privilege IAM without "
+                        "s3:ListBucket answers 403 for missing keys). If "
+                        "reads are genuinely denied, every request will "
+                        "recompute: check the bucket read policy.",
+                        name,
+                    )
                 return None
             raise
         mtime = None

@@ -49,3 +49,29 @@ def test_bulk_cli_and_bad_file(tmp_path, capsys):
     summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert summary["images"] == 3 and summary["failed"] == 1
     assert sorted(os.listdir(out)) == [f"img{i}.png" for i in range(3)]
+
+
+def test_bulk_matches_serving_transform_for_post_pass_options(tmp_path):
+    """Bulk routes through ImageHandler.transform_bytes — the serving
+    pipeline — so options the old bulk path silently skipped (smart-crop,
+    st_0 metadata graft) now produce byte-identical output to serving."""
+    from flyimg_tpu.appconfig import AppParameters
+    from flyimg_tpu.service.handler import ImageHandler
+    from flyimg_tpu.service.output_image import OutputSpec
+    from flyimg_tpu.spec.options import OptionsBag
+
+    src = _make_dir(tmp_path, n=1)
+    out = tmp_path / "out"
+    opts = "w_120,h_90,c_1,smc_1"
+    summary = bulk_process(
+        str(src), str(out), opts, out_format="jpg", workers=1
+    )
+    assert summary["failed"] == 0
+    bulk_bytes = (out / "img0.jpg").read_bytes()
+
+    handler = ImageHandler(storage=None, params=AppParameters())
+    spec = OutputSpec(name="x.jpg", extension="jpg", mime="image/jpeg")
+    serve_bytes = handler.transform_bytes(
+        (src / "img0.png").read_bytes(), OptionsBag(opts), spec
+    )
+    assert bulk_bytes == serve_bytes

@@ -326,3 +326,47 @@ def test_exif_orientation_matches_pil_all_eight():
         )
         assert ours.shape == ref.shape, orient
         np.testing.assert_array_equal(ours, ref, err_msg=f"orientation {orient}")
+
+
+def test_exif_malformed_offsets_never_raise_or_corrupt():
+    """EXIF IFD offsets are attacker-controlled. Two crafted cases:
+    (a) the 0x0112 tag id is readable but its value field lies past EOF —
+    orientation must fall back to 1, not raise struct.error (which would
+    turn every request on that image into a 500);
+    (b) the IFD offset points PAST the APP1 segment into trailing file
+    bytes — extract_app1 must not slice-assign beyond the copied segment,
+    which would desync the grafted segment's declared length from its
+    actual bytes (serving a corrupt JPEG on st_0)."""
+    import struct as _s
+
+    from flyimg_tpu.codecs.exif import extract_app1, jpeg_orientation
+
+    def app1(payload: bytes, declared_len: int) -> bytes:
+        return b"\xff\xe1" + _s.pack(">H", declared_len) + payload
+
+    # (a) truncated: full entry would be 12 bytes; keep only tag+type
+    tiff = b"II*\x00" + _s.pack("<I", 8) + _s.pack("<H", 1)
+    entry_head = _s.pack("<HH", 0x0112, 3)  # tag readable, value absent
+    payload = b"Exif\x00\x00" + tiff + entry_head
+    declared = 2 + len(payload) + 8  # claims the full entry is present
+    truncated = b"\xff\xd8" + app1(payload, declared)
+    assert jpeg_orientation(truncated) == 1
+    # declared seglen runs past EOF: grafting a short copy would desync
+    # declared vs actual bytes, so the graft must be skipped outright
+    assert extract_app1(truncated) is None
+
+    # (b) IFD offset escapes the segment: entry lives in trailing bytes
+    tiff_esc = b"II*\x00" + _s.pack("<I", 64)  # IFD far past the segment
+    payload_esc = b"Exif\x00\x00" + tiff_esc
+    seg = app1(payload_esc, 2 + len(payload_esc))
+    trailer = b"\x00" * 50 + _s.pack("<H", 1) + _s.pack(
+        "<HHIHH", 0x0112, 3, 1, 6, 0
+    )
+    crafted = b"\xff\xd8" + seg + trailer + b"\xff\xd9"
+    # the out-of-segment entry must not be trusted for rotation...
+    assert jpeg_orientation(crafted) == 1
+    grafted = extract_app1(crafted)
+    # ...and the grafted segment's declared length must equal its bytes
+    if grafted is not None:
+        declared_len = _s.unpack(">H", grafted[2:4])[0]
+        assert len(grafted) == 2 + declared_len

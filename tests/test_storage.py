@@ -13,6 +13,29 @@ from flyimg_tpu.storage import make_storage
 from flyimg_tpu.storage.local import LocalStorage
 
 
+import contextlib
+import logging
+
+
+@contextlib.contextmanager
+def _capture_warnings(logger_name):
+    """Collect WARNING+ records from one logger (caplog equivalent that
+    doesn't depend on fixture ordering with the s3 fixture)."""
+    records = []
+
+    class _H(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    logger = logging.getLogger(logger_name)
+    h = _H(level=logging.WARNING)
+    logger.addHandler(h)
+    try:
+        yield records
+    finally:
+        logger.removeHandler(h)
+
+
 @pytest.fixture()
 def local(tmp_path):
     params = AppParameters({"upload_dir": str(tmp_path / "up")})
@@ -172,9 +195,12 @@ def test_s3_read_failure_bubbles(s3):
 def test_s3_non_notfound_errors_propagate(s3):
     """Throttling/outage errors must NOT read as cache misses: a miss
     triggers a full recompute + rewrite, so an S3 outage misread as
-    'absent' becomes a silent cost amplification. Only not-found codes
-    (including 403/AccessDenied — S3's answer for a missing key without
-    s3:ListBucket) map to None/False."""
+    'absent' becomes a silent cost amplification. 403/AccessDenied is
+    S3's answer for a MISSING key (on HeadObject and GetObject alike)
+    whenever credentials lack s3:ListBucket — the common least-privilege
+    IAM shape — so it must read as a miss everywhere; but because a
+    genuinely denied read policy then also presents as permanent misses,
+    fetch() logs the first swallowed GetObject 403 as an error signal."""
 
     class _Throttled(Exception):
         response = {"Error": {"Code": "SlowDown"}}
@@ -203,8 +229,12 @@ def test_s3_non_notfound_errors_propagate(s3):
     client.get_object = deny
     # least-privilege IAM: missing key answers 403 -> must read as a miss
     assert storage.stat("k.webp") is None
-    assert storage.fetch("k.webp") is None
     assert storage.has("k.webp") is False
+    with _capture_warnings("flyimg_tpu.storage.s3") as records:
+        assert storage.fetch("k.webp") is None
+        assert storage.fetch("k.webp") is None
+    # ...with exactly ONE warning so a denied read policy is visible
+    assert len(records) == 1 and "403" in records[0].getMessage()
 
 
 def test_s3_write_survives_throttled_stamp_readback(s3):
