@@ -101,7 +101,11 @@ class BlazeFaceBackend:
 
 
 class FacefindBackend:
-    """Classical skin-blob proposer (no external data requirements)."""
+    """Classical skin-blob proposer (no external data requirements).
+
+    Opt-in ONLY (``face_backend: facefind``): it proposes skin-toned
+    REGIONS, not faces, so fb_1 under it can pixelate arms/crowds. That
+    trade-off must be chosen by an operator, never reached by fallback."""
 
     detect_faces = staticmethod(facefind.detect_faces)
     prepare_face_work = staticmethod(facefind.prepare_face_work)
@@ -110,13 +114,34 @@ class FacefindBackend:
     crop_face = staticmethod(facefind.crop_face)
 
 
+class NullBackend:
+    """Zero-faces backend: face options silently no-op, exactly the
+    reference's behavior when its facedetect binary is missing
+    (FaceDetectProcessor.php:24,53 — `if (!file_exists(...)) return;`).
+    A wrong transform (pixelating skin that isn't a face) is worse than
+    none, so this — not the skin proposer — is the fallback when no real
+    detector is installed."""
+
+    @staticmethod
+    def detect_faces(rgb: np.ndarray) -> List[Box]:
+        del rgb
+        return []
+
+    # zero boxes no-op both downstream ops, matching the reference's
+    # "no facedetect binary -> the option does nothing" contract
+    blur_faces = staticmethod(facefind.blur_faces)
+    crop_face = staticmethod(facefind.crop_face)
+
+
 def make_face_backend(
     name: str = "auto", checkpoint: Optional[str] = None
 ):
     """Resolve the serving face backend. ``auto`` prefers the reference's
-    own detector family (haar) where cascade files exist, falling back to
-    the skin-blob proposer; ``blazeface`` uses ``checkpoint`` or the
-    packaged weights."""
+    own detector family (haar) where cascade files exist, then the
+    packaged BlazeFace checkpoint, then the zero-faces no-op backend
+    (reference semantics when no detector is installed); the skin-blob
+    proposer is never reached implicitly. ``blazeface`` uses
+    ``checkpoint`` or the packaged weights."""
     name = (name or "auto").lower()
     if name == "blazeface":
         ckpt = checkpoint or PACKAGED_BLAZEFACE
@@ -130,10 +155,14 @@ def make_face_backend(
         return HaarBackend(checkpoint)
     if name == "facefind":
         return FacefindBackend()
+    if name in ("none", "null"):
+        return NullBackend()
     if name == "auto":
         from flyimg_tpu.models import haar
 
         if haar.available():
             return HaarBackend()
-        return FacefindBackend()
+        if os.path.exists(PACKAGED_BLAZEFACE):
+            return BlazeFaceBackend(PACKAGED_BLAZEFACE)
+        return NullBackend()
     raise ValueError(f"unknown face_backend {name!r}")

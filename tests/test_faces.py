@@ -156,3 +156,27 @@ def test_blazeface_checkpoint_finds_real_face():
     bf_boxes = backend.detect_faces(img)
     assert bf_boxes, "no face detected by blazeface"
     assert max(_iou(b, haar_boxes[0]) for b in bf_boxes[:3]) >= 0.3
+
+
+def test_auto_without_detectors_noops_face_ops(monkeypatch):
+    """Reference semantics: with no detector installed, face options
+    silently no-op (FaceDetectProcessor.php:24,53). The skin proposer
+    must never be reached by fallback — pixelating a skin-toned region
+    that isn't a face is worse than doing nothing."""
+    import numpy as np
+
+    from flyimg_tpu.models import faces as faces_mod
+    from flyimg_tpu.models import haar
+    from flyimg_tpu.models.faces import NullBackend
+
+    monkeypatch.setattr(haar, "available", lambda: False)
+    monkeypatch.setattr(faces_mod, "PACKAGED_BLAZEFACE", "/nonexistent")
+    backend = faces_mod.make_face_backend("auto")
+    assert isinstance(backend, NullBackend)
+    img = np.full((60, 80, 3), 200, np.uint8)  # all skin-ish tones
+    assert backend.detect_faces(img) == []
+    # zero boxes -> blur and crop are identity
+    np.testing.assert_array_equal(backend.blur_faces(img, []), img)
+    np.testing.assert_array_equal(
+        backend.crop_face(img, [], 0), img
+    )
