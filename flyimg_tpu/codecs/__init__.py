@@ -80,8 +80,29 @@ def decode(
         elif info.mime == "image/png":
             decoded = native_codec.png_decode(data)
             if decoded is not None:
-                return _split_alpha(decoded, "image/png")
+                return _orient_png(_split_alpha(decoded, "image/png"), data)
+    # NOTE: no _orient_png here — the PIL fallback already runs
+    # ImageOps.exif_transpose (pil_codec.py:76), which honors PNG eXIf;
+    # applying it again would double-rotate
     return pil_codec.decode(data, target_hint=target_hint, frame=frame)
+
+
+def _orient_png(decoded: DecodedImage, data: bytes) -> DecodedImage:
+    """Apply PNG eXIf orientation on the NATIVE decode path (IM's
+    -auto-orient honors orientation in any container; libpng doesn't)."""
+    from flyimg_tpu.codecs.metadata import png_orientation
+
+    orientation = png_orientation(data)
+    if orientation == 1:
+        return decoded
+    rgb = np.ascontiguousarray(apply_orientation(decoded.rgb, orientation))
+    alpha = decoded.alpha
+    if alpha is not None:
+        alpha = np.ascontiguousarray(apply_orientation(alpha, orientation))
+    return DecodedImage(
+        rgb=rgb, alpha=alpha, mime=decoded.mime, orig_size=decoded.orig_size,
+        n_frames=decoded.n_frames,
+    )
 
 
 def _split_alpha(decoded, mime: str) -> DecodedImage:
@@ -126,6 +147,68 @@ def batch_jpeg_decode(items: list) -> list:
     return results
 
 
+#: IM ratio spellings -> luma (h, v) sampling factors. The geometry form
+#: "HxV" is parsed directly; both grammars are what the reference forwards
+#: verbatim to `-sampling-factor` (ImageProcessor.php:105, default 1x1 at
+#: config/parameters.yml:102).
+_SAMPLING_RATIOS = {
+    "4:4:4": (1, 1),
+    "4:2:2": (2, 1),
+    "4:2:0": (2, 2),
+    "4:4:0": (1, 2),
+    "4:1:1": (4, 1),
+    "4:1:0": (4, 2),
+}
+
+
+def parse_sampling_factor(value) -> Tuple[int, int]:
+    """IM -sampling-factor grammar -> luma (h, v) factor pair. Accepts the
+    geometry form ``HxV`` (1..4 each, h*v <= 8 per the JPEG MCU budget)
+    and the ratio form ``4:2:0`` etc. Unparseable values raise — the
+    reference would hand them to `convert`, which errors out
+    (ExecFailedException); silent coercion to some other subsampling would
+    change image content without telling the caller."""
+    from flyimg_tpu.exceptions import InvalidArgumentException
+
+    s = str(value if value is not None else "1x1").strip().lower()
+    if not s:
+        return (1, 1)
+    if s in _SAMPLING_RATIOS:
+        return _SAMPLING_RATIOS[s]
+    parts = s.split("x")
+    if len(parts) == 2 and parts[0].isdigit() and parts[1].isdigit():
+        h, v = int(parts[0]), int(parts[1])
+        if 1 <= h <= 4 and 1 <= v <= 4 and h * v <= 8:
+            return (h, v)
+    raise InvalidArgumentException(
+        f"invalid sampling factor {value!r} (expected HxV with factors "
+        "1..4, h*v <= 8, or a ratio like 4:2:0)"
+    )
+
+
+def batch_jpeg_encode(items: list) -> list:
+    """Aux-group runner: encode many RGB frames to JPEG in ONE native pool
+    call — C worker threads run the (expensive) trellis DP in parallel.
+    ``items`` are (rgb, quality, sampling, mozjpeg) tuples with uniform
+    parameters (the aux group key carries them); returns encoded bytes per
+    item (None = fall back to the single-image encode()). moz_0 means a
+    BASELINE encode — no trellis, no Huffman optimization, no progressive
+    scans — exactly matching the single-image encode(mozjpeg=False) path
+    so the pooled and fallback bytes are identical for one cache key."""
+    pool = native_codec.get_pool()
+    if pool is None:
+        return [None] * len(items)
+    _, quality, sampling, mozjpeg = items[0]
+    return pool.encode_batch(
+        [frame for frame, _q, _s, _m in items],
+        quality,
+        trellis=mozjpeg,
+        optimize=mozjpeg,
+        progressive=mozjpeg,
+        sampling=sampling,
+    )
+
+
 def encode(
     image: np.ndarray,
     fmt: str,
@@ -155,12 +238,12 @@ def encode(
             return blob
     if native_codec.available() and alpha is None:
         if fmt in ("jpg", "jpeg"):
+            sampling = parse_sampling_factor(sampling_factor)
             if mozjpeg:
                 # moz_1 (default): trellis quantization + optimized Huffman
                 # + progressive — the cjpeg technique set
                 blob = native_codec.jpeg_encode_trellis(
-                    image, quality,
-                    subsampling_444=(sampling_factor == "1x1"),
+                    image, quality, sampling=sampling
                 )
                 if blob is not None:
                     return blob
@@ -169,7 +252,7 @@ def encode(
                 quality,
                 optimize=bool(mozjpeg),
                 progressive=bool(mozjpeg),
-                subsampling_444=(sampling_factor == "1x1"),
+                sampling=sampling,
             )
             if blob is not None:
                 return blob

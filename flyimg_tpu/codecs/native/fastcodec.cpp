@@ -112,13 +112,24 @@ uint8_t* fc_jpeg_decode(const uint8_t* data, size_t len, int scale_num,
   return out;
 }
 
+// Luma sampling factors must satisfy the JPEG MCU budget (sum of h*v over
+// components <= 10; chroma is always 1x1 here, so luma h*v <= 8) and
+// libjpeg's 1..4 range. ImageMagick enforces the same constraints on its
+// -sampling-factor geometry.
+static bool fc_samp_valid(int samp_h, int samp_v) {
+  return samp_h >= 1 && samp_h <= 4 && samp_v >= 1 && samp_v <= 4 &&
+         samp_h * samp_v <= 8;
+}
+
 // Encode RGB8 to JPEG. quality 0..100; optimize!=0 enables optimized Huffman
-// tables; progressive!=0 enables the progressive scan script; subsampling:
-// 0 = 4:4:4 (the reference's default sampling-factor 1x1,
-// config/parameters.yml:103), 2 = 4:2:0.
+// tables; progressive!=0 enables the progressive scan script; samp_h/samp_v
+// are the LUMA sampling factors (chroma stays 1x1), the IM -sampling-factor
+// "HxV" geometry: 1x1 = 4:4:4 (the reference's default,
+// config/parameters.yml:102), 2x2 = 4:2:0, 2x1 = 4:2:2, 1x2 = 4:4:0.
 uint8_t* fc_jpeg_encode(const uint8_t* rgb, int width, int height, int quality,
-                        int optimize, int progressive, int subsampling,
+                        int optimize, int progressive, int samp_h, int samp_v,
                         size_t* out_len) {
+  if (!fc_samp_valid(samp_h, samp_v)) return nullptr;
   jpeg_compress_struct cinfo;
   fc_jpeg_error_mgr jerr;
   cinfo.err = jpeg_std_error(&jerr.pub);
@@ -140,12 +151,9 @@ uint8_t* fc_jpeg_encode(const uint8_t* rgb, int width, int height, int quality,
   jpeg_set_quality(&cinfo, quality, TRUE);
   cinfo.optimize_coding = optimize ? TRUE : FALSE;
   if (progressive) jpeg_simple_progression(&cinfo);
-  if (subsampling == 0) {
-    // 4:4:4 — no chroma subsampling
-    for (int i = 0; i < cinfo.num_components; ++i) {
-      cinfo.comp_info[i].h_samp_factor = 1;
-      cinfo.comp_info[i].v_samp_factor = 1;
-    }
+  for (int i = 0; i < cinfo.num_components; ++i) {
+    cinfo.comp_info[i].h_samp_factor = (i == 0) ? samp_h : 1;
+    cinfo.comp_info[i].v_samp_factor = (i == 0) ? samp_v : 1;
   }
   jpeg_start_compress(&cinfo, TRUE);
   const int stride = width * 3;
@@ -401,18 +409,23 @@ static void trellis_ac(const float* cz, const uint16_t* qz, float lambda,
 }  // namespace trellis
 
 // Encode RGB8 to JPEG with trellis quantization + optimized Huffman +
-// progressive scans — the full MozJPEG technique set. subsampling:
-// 0 = 4:4:4, 2 = 4:2:0.
+// progressive scans — the full MozJPEG technique set. samp_h/samp_v are
+// the LUMA sampling factors (chroma 1x1), the IM -sampling-factor "HxV"
+// geometry: 1x1 = 4:4:4, 2x2 = 4:2:0, 2x1 = 4:2:2, 1x2 = 4:4:0.
 uint8_t* fc_jpeg_encode_trellis(const uint8_t* rgb, int width, int height,
-                                int quality, int subsampling, int progressive,
-                                size_t* out_len) {
+                                int quality, int samp_h, int samp_v,
+                                int progressive, size_t* out_len) {
   using namespace trellis;
+  if (!fc_samp_valid(samp_h, samp_v)) return nullptr;
   ensure_rate_tables();
   ensure_cos();
 
-  const int sub = (subsampling == 2) ? 2 : 1;
-  const int comp_w[3] = {width, (width + sub - 1) / sub, (width + sub - 1) / sub};
-  const int comp_h[3] = {height, (height + sub - 1) / sub, (height + sub - 1) / sub};
+  const int sub_h = samp_h, sub_v = samp_v;
+  const bool subsampled = sub_h > 1 || sub_v > 1;
+  const int comp_w[3] = {width, (width + sub_h - 1) / sub_h,
+                         (width + sub_h - 1) / sub_h};
+  const int comp_h[3] = {height, (height + sub_v - 1) / sub_v,
+                         (height + sub_v - 1) / sub_v};
 
   // RGB -> YCbCr planes (JFIF), chroma box-downsampled for 4:2:0
   std::vector<std::vector<float>> planes(3);
@@ -421,7 +434,7 @@ uint8_t* fc_jpeg_encode_trellis(const uint8_t* rgb, int width, int height,
   }
   {
     std::vector<float> cb_full, cr_full;
-    if (sub == 2) {
+    if (subsampled) {
       cb_full.resize(static_cast<size_t>(width) * height);
       cr_full.resize(static_cast<size_t>(width) * height);
     }
@@ -433,7 +446,7 @@ uint8_t* fc_jpeg_encode_trellis(const uint8_t* rgb, int width, int height,
         const float cbv = -0.168735892f * r - 0.331264108f * g + 0.5f * b + 128.f;
         const float crv = 0.5f * r - 0.418687589f * g - 0.081312411f * b + 128.f;
         planes[0][static_cast<size_t>(y) * width + x] = yv;
-        if (sub == 2) {
+        if (subsampled) {
           cb_full[static_cast<size_t>(y) * width + x] = cbv;
           cr_full[static_cast<size_t>(y) * width + x] = crv;
         } else {
@@ -442,7 +455,9 @@ uint8_t* fc_jpeg_encode_trellis(const uint8_t* rgb, int width, int height,
         }
       }
     }
-    if (sub == 2) {
+    if (subsampled) {
+      // box-downsample chroma by sub_h x sub_v (edge cells average only
+      // the in-bounds samples)
       for (int c = 0; c < 2; ++c) {
         const std::vector<float>& full = c == 0 ? cb_full : cr_full;
         std::vector<float>& out = planes[c + 1];
@@ -450,9 +465,9 @@ uint8_t* fc_jpeg_encode_trellis(const uint8_t* rgb, int width, int height,
           for (int x = 0; x < comp_w[1]; ++x) {
             float acc = 0.f;
             int cnt = 0;
-            for (int dy = 0; dy < 2; ++dy) {
-              for (int dx = 0; dx < 2; ++dx) {
-                const int sy = y * 2 + dy, sx = x * 2 + dx;
+            for (int dy = 0; dy < sub_v; ++dy) {
+              for (int dx = 0; dx < sub_h; ++dx) {
+                const int sy = y * sub_v + dy, sx = x * sub_h + dx;
                 if (sy < height && sx < width) {
                   acc += full[static_cast<size_t>(sy) * width + sx];
                   ++cnt;
@@ -511,26 +526,28 @@ uint8_t* fc_jpeg_encode_trellis(const uint8_t* rgb, int width, int height,
   cinfo.optimize_coding = TRUE;
   if (progressive) jpeg_simple_progression(&cinfo);
   for (int c = 0; c < 3; ++c) {
-    cinfo.comp_info[c].h_samp_factor = (c == 0) ? sub : 1;
-    cinfo.comp_info[c].v_samp_factor = (c == 0) ? sub : 1;
+    cinfo.comp_info[c].h_samp_factor = (c == 0) ? sub_h : 1;
+    cinfo.comp_info[c].v_samp_factor = (c == 0) ? sub_v : 1;
   }
 
   jvirt_barray_ptr coef_arrays[3];
-  const int mcu_blocks = 8 * sub;  // luma MCU span in samples
+  const int mcu_span_x = 8 * sub_h;  // luma MCU span in samples
+  const int mcu_span_y = 8 * sub_v;
   for (int c = 0; c < 3; ++c) {
     const int bw = (comp_w[c] + 7) / 8;
     const int bh = (comp_h[c] + 7) / 8;
     // round block dims up to the MCU grid like libjpeg expects
-    const int samp = (c == 0) ? sub : 1;
-    const int mcus_x = (width + mcu_blocks - 1) / mcu_blocks;
-    const int mcus_y = (height + mcu_blocks - 1) / mcu_blocks;
-    const int full_bw = mcus_x * samp;
-    const int full_bh = mcus_y * samp;
+    const int ch = (c == 0) ? sub_h : 1;
+    const int cv = (c == 0) ? sub_v : 1;
+    const int mcus_x = (width + mcu_span_x - 1) / mcu_span_x;
+    const int mcus_y = (height + mcu_span_y - 1) / mcu_span_y;
+    const int full_bw = mcus_x * ch;
+    const int full_bh = mcus_y * cv;
     coef_arrays[c] = (*cinfo.mem->request_virt_barray)(
         reinterpret_cast<j_common_ptr>(&cinfo), JPOOL_IMAGE, TRUE,
         static_cast<JDIMENSION>(full_bw > bw ? full_bw : bw),
         static_cast<JDIMENSION>(full_bh > bh ? full_bh : bh),
-        static_cast<JDIMENSION>(samp));
+        static_cast<JDIMENSION>(cv));
   }
   jpeg_write_coefficients(&cinfo, coef_arrays);
 
@@ -875,6 +892,58 @@ void fc_pool_decode_jpeg_batch(fc_pool* pool, fc_batch_item* items, int n) {
       pool->tasks.emplace([item, &remaining, &done_mu, &done_cv] {
         item->out = fc_jpeg_decode(item->data, item->len, item->scale_num,
                                    &item->width, &item->height);
+        if (remaining.fetch_sub(1) == 1) {
+          std::lock_guard<std::mutex> dl(done_mu);
+          done_cv.notify_all();
+        }
+      });
+    }
+    pool->cv.notify_one();
+  }
+  std::unique_lock<std::mutex> lock(done_mu);
+  done_cv.wait(lock, [&remaining] { return remaining.load() == 0; });
+}
+
+struct fc_encode_item {
+  const uint8_t* rgb;
+  int width;
+  int height;
+  int quality;
+  int trellis;      // 1 = trellis DP (moz path), 0 = plain libjpeg encode
+  int optimize;     // plain path only (trellis always optimizes Huffman)
+  int progressive;
+  int samp_h;       // luma sampling factors (IM -sampling-factor HxV)
+  int samp_v;
+  uint8_t* out;     // fc_free() when done; null on per-image failure
+  size_t out_len;
+};
+
+// Encode a batch of RGB frames to JPEG in parallel on the pool; blocks
+// until done. The trellis DP is the expensive half of the miss path
+// (SURVEY.md hard part 2: "MozJPEG host encode must be threaded or it
+// becomes the serial bottleneck") — this is the encode-side twin of
+// fc_pool_decode_jpeg_batch, so a 32-way burst of misses pays ~one
+// encode latency, not 32.
+void fc_pool_encode_jpeg_batch(fc_pool* pool, fc_encode_item* items, int n) {
+  std::atomic<int> remaining{n};
+  std::mutex done_mu;
+  std::condition_variable done_cv;
+  for (int i = 0; i < n; ++i) {
+    fc_encode_item* item = &items[i];
+    {
+      std::lock_guard<std::mutex> lock(pool->mu);
+      pool->tasks.emplace([item, &remaining, &done_mu, &done_cv] {
+        item->out_len = 0;
+        if (item->trellis) {
+          item->out = fc_jpeg_encode_trellis(
+              item->rgb, item->width, item->height, item->quality,
+              item->samp_h, item->samp_v, item->progressive, &item->out_len);
+        } else {
+          item->out = fc_jpeg_encode(
+              item->rgb, item->width, item->height, item->quality,
+              item->optimize, item->progressive, item->samp_h, item->samp_v,
+              &item->out_len);
+        }
         if (remaining.fetch_sub(1) == 1) {
           std::lock_guard<std::mutex> dl(done_mu);
           done_cv.notify_all();

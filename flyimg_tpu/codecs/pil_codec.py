@@ -118,7 +118,17 @@ def encode(
         pil.putalpha(Image.fromarray(alpha))
     buf = io.BytesIO()
     if fmt in ("jpg", "jpeg"):
-        subsampling = 0 if sampling_factor == "1x1" else 2
+        from flyimg_tpu.codecs import parse_sampling_factor
+
+        h_samp, v_samp = parse_sampling_factor(sampling_factor)
+        # PIL exposes only libjpeg's 3 presets; map by chroma data rate
+        # (4:4:0/4:1:1 land on the nearest available halving)
+        if (h_samp, v_samp) == (1, 1):
+            subsampling = 0          # 4:4:4
+        elif h_samp * v_samp == 2:
+            subsampling = 1          # 4:2:2 (also stands in for 4:4:0)
+        else:
+            subsampling = 2          # 4:2:0 and coarser
         pil.save(
             buf,
             "JPEG",
