@@ -4,11 +4,16 @@ The reference always emits ``-auto-orient`` (src/Core/Processor/
 ImageProcessor.php:78); the native JPEG decode path bypasses PIL, so
 orientation is parsed here directly from the APP1/TIFF header (tag 0x0112)
 and applied as numpy flips/transposes (exact, copy-light).
+
+This module owns THE TIFF/IFD0 parser (:func:`tiff_orientation` /
+:func:`reset_tiff_orientation`) — codecs/metadata.py reuses it for PNG
+eXIf chunks so orientation semantics can never drift between containers.
 """
 
 from __future__ import annotations
 
 import struct
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -19,11 +24,59 @@ import numpy as np
 _SCAN_LIMIT = 4 * 1024 * 1024
 
 
-def _find_exif_app1(data: bytes):
-    """(segment_offset, segment_length, tiff_entry_offset_of_0x0112 or -1,
-    endian) of the first EXIF APP1, or None. The single JPEG marker walk +
-    TIFF/IFD0 parse shared by every EXIF reader here — one parser, one
-    scan limit, no drift."""
+def _tiff_orientation_entry(tiff: bytes) -> Optional[Tuple[int, str]]:
+    """(value_offset, endian) of IFD0's 0x0112 value field in a raw TIFF
+    stream. Every offset is attacker-controlled, so the entry is returned
+    only when its full 12 bytes lie inside the stream; None otherwise.
+    Callers slice ``tiff`` to its containing segment first, which makes
+    this single bounds check cover both the buffer and the segment."""
+    try:
+        if tiff[:2] == b"II":
+            endian = "<"
+        elif tiff[:2] == b"MM":
+            endian = ">"
+        else:
+            return None
+        (ifd_off,) = struct.unpack(endian + "I", tiff[4:8])
+        (count,) = struct.unpack(endian + "H", tiff[ifd_off : ifd_off + 2])
+        for k in range(count):
+            entry = ifd_off + 2 + 12 * k
+            if entry + 12 > len(tiff):
+                return None
+            (tag,) = struct.unpack(endian + "H", tiff[entry : entry + 2])
+            if tag == 0x0112:
+                return entry + 8, endian
+        return None
+    except (struct.error, IndexError):
+        return None
+
+
+def tiff_orientation(tiff: bytes) -> int:
+    """EXIF orientation 1..8 from a raw TIFF stream; 1 on any failure."""
+    found = _tiff_orientation_entry(tiff)
+    if found is None:
+        return 1
+    off, endian = found
+    (value,) = struct.unpack(endian + "H", tiff[off : off + 2])
+    return value if 1 <= value <= 8 else 1
+
+
+def reset_tiff_orientation(tiff: bytes) -> bytes:
+    """Orientation tag -> 1 (the pipeline bakes rotation into pixels, so
+    carried-over metadata must not instruct viewers to rotate again)."""
+    found = _tiff_orientation_entry(tiff)
+    if found is None:
+        return tiff
+    off, endian = found
+    out = bytearray(tiff)
+    out[off : off + 2] = struct.pack(endian + "H", 1)
+    return bytes(out)
+
+
+def _find_exif_app1(data: bytes) -> Optional[Tuple[int, int]]:
+    """(segment_offset, declared_segment_length) of the first EXIF APP1 in
+    a JPEG, or None. Marker walk only — TIFF parsing happens on the
+    segment-bounded slice via the functions above."""
     try:
         i = 2
         n = min(len(data), _SCAN_LIMIT)
@@ -38,94 +91,30 @@ def _find_exif_app1(data: bytes):
                 return None
             seglen = struct.unpack(">H", data[i + 2 : i + 4])[0]
             if marker == 0xE1 and data[i + 4 : i + 10] == b"Exif\x00\x00":
-                tiff = i + 10
-                if data[tiff : tiff + 2] == b"II":
-                    endian = "<"
-                elif data[tiff : tiff + 2] == b"MM":
-                    endian = ">"
-                else:
-                    return None
-                (ifd_off,) = struct.unpack(
-                    endian + "I", data[tiff + 4 : tiff + 8]
-                )
-                ifd = tiff + ifd_off
-                (count,) = struct.unpack(endian + "H", data[ifd : ifd + 2])
-                for k in range(count):
-                    entry = ifd + 2 + 12 * k
-                    (tag,) = struct.unpack(
-                        endian + "H", data[entry : entry + 2]
-                    )
-                    if tag == 0x0112:
-                        # IFD offsets are attacker-controlled: only hand the
-                        # entry back when its full 12 bytes lie inside BOTH
-                        # the buffer (jpeg_orientation unpacks entry+8..10)
-                        # and the APP1 segment (extract_app1 slice-assigns
-                        # into the copied segment — writing past it would
-                        # desync the declared length from the actual bytes).
-                        # Out-of-bounds ⇒ treat as "no orientation entry":
-                        # pixels stay unrotated AND the graft keeps the raw
-                        # tag bytes, so the two readers stay consistent.
-                        if (
-                            entry + 12 <= len(data)
-                            and entry + 12 <= i + 2 + seglen
-                        ):
-                            return i, seglen, entry, endian
-                        return i, seglen, -1, endian
-                return i, seglen, -1, endian
+                return i, seglen
             i += 2 + seglen
         return None
     except (struct.error, IndexError):
         return None
 
 
-def jpeg_orientation(data: bytes) -> int:
-    """EXIF orientation 1..8 (1 = upright) from JPEG bytes; 1 on any parse
-    failure."""
-    found = _find_exif_app1(data)
-    if found is None or found[2] < 0:
-        return 1
-    _, _, entry, endian = found
-    (value,) = struct.unpack(endian + "H", data[entry + 8 : entry + 10])
-    return value if 1 <= value <= 8 else 1
-
-
-def extract_app1(data: bytes) -> bytes | None:
-    """The source JPEG's EXIF APP1 segment (marker + length + payload),
-    with its orientation tag rewritten to 1 — the pipeline bakes the
-    rotation into pixels, so carried-over metadata must not re-rotate.
-    None when absent/unparseable. Powers reference `st_0` semantics:
-    without -strip, ImageMagick preserves source metadata
-    (ImageProcessor.php:97-99); a decode-to-raw-pixels pipeline must
-    graft it back explicitly."""
+def _app1_tiff(data: bytes) -> Optional[bytes]:
+    """The TIFF stream inside the first EXIF APP1, sliced to the SEGMENT
+    bound (never past it, never past EOF) so downstream offset checks are
+    automatically segment-relative."""
     found = _find_exif_app1(data)
     if found is None:
         return None
-    i, seglen, entry, endian = found
-    if i + 2 + seglen > len(data):
-        # truncated file: the segment's declared length runs past EOF, so
-        # a copy would hold fewer bytes than it declares and downstream
-        # parsers of the grafted output would eat into the next marker —
-        # skip the graft entirely
-        return None
-    seg = bytearray(data[i : i + 2 + seglen])
-    if entry >= 0:
-        rel = entry - i  # entry offset inside the copied segment
-        seg[rel + 8 : rel + 10] = struct.pack(endian + "H", 1)
-    return bytes(seg)
+    i, seglen = found
+    end = min(i + 2 + seglen, len(data))
+    return data[i + 10 : end]
 
 
-def inject_app1(jpeg: bytes, app1: bytes) -> bytes:
-    """Insert an APP1 segment into encoded JPEG bytes, after SOI and any
-    APP0/JFIF segment (the canonical position). Returns the input
-    unchanged when it doesn't look like a JPEG."""
-    if jpeg[:2] != b"\xff\xd8":
-        return jpeg
-    pos = 2
-    # skip existing APP0 (JFIF) so APP1 lands in its standard slot
-    while pos + 4 <= len(jpeg) and jpeg[pos] == 0xFF and jpeg[pos + 1] == 0xE0:
-        (seglen,) = struct.unpack(">H", jpeg[pos + 2 : pos + 4])
-        pos += 2 + seglen
-    return jpeg[:pos] + app1 + jpeg[pos:]
+def jpeg_orientation(data: bytes) -> int:
+    """EXIF orientation 1..8 (1 = upright) from JPEG bytes; 1 on any parse
+    failure."""
+    tiff = _app1_tiff(data)
+    return 1 if tiff is None else tiff_orientation(tiff)
 
 
 def apply_orientation(rgb: np.ndarray, orientation: int) -> np.ndarray:

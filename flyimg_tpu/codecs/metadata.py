@@ -1,0 +1,262 @@
+"""Source-metadata carry for ``st_0`` outputs.
+
+The reference omits ``-strip`` unless st_1, so ImageMagick preserves ALL
+source metadata — EXIF, ICC profile, XMP — in every output format
+(src/Core/Processor/ImageProcessor.php:97-99). A decode-to-raw-pixels
+pipeline loses those bytes, so this module collects them from the source
+container and grafts them into the encoded output:
+
+- JPEG in: APP1/Exif (via codecs/exif.py, orientation reset), APP2
+  ICC_PROFILE chunks (re-assembled across segments), APP1/XMP.
+- PNG in: iCCP (zlib-inflated) and eXIf chunks.
+- JPEG out: APP1 Exif + APP1 XMP + APP2 ICC (re-split into the standard
+  <= 65519-byte ICC_PROFILE chunk train) injected after APP0.
+- PNG out: iCCP (deflated) + eXIf chunks inserted right after IHDR
+  (iCCP must precede PLTE/IDAT, PNG 1.2 section 4.2).
+
+WebP outputs still drop metadata (RIFF/VP8X surgery is not implemented);
+the handler documents that residual gap.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from dataclasses import dataclass
+from typing import List, Optional
+
+from flyimg_tpu.codecs.exif import (
+    _SCAN_LIMIT,
+    reset_tiff_orientation,
+    tiff_orientation,
+)
+
+_EXIF_HEADER = b"Exif\x00\x00"
+
+_ICC_HEADER = b"ICC_PROFILE\x00"
+_XMP_HEADER = b"http://ns.adobe.com/xap/1.0/\x00"
+# max ICC payload bytes per APP2: 65535 (seg len field ceiling) - 2 (the
+# length field counts itself) - 12 (ICC_PROFILE\0) - 2 (seq/count bytes)
+_ICC_CHUNK = 65519
+_PNG_SIG = b"\x89PNG\r\n\x1a\n"
+
+
+@dataclass
+class SourceMetadata:
+    """What survives a transform when -strip is off. EXIF is held as the
+    raw TIFF stream (orientation already reset) — container framing is an
+    INJECT-time concern: JPEG wraps it in an APP1 (64KB cap applies only
+    there), PNG writes it verbatim into eXIf (2^31 chunk limit)."""
+
+    exif_tiff: Optional[bytes] = None  # raw TIFF stream, orientation reset
+    icc: Optional[bytes] = None        # raw ICC profile bytes
+    xmp: Optional[bytes] = None        # raw XMP packet (no namespace header)
+
+    def __bool__(self) -> bool:
+        return any((self.exif_tiff, self.icc, self.xmp))
+
+
+# ---------------------------------------------------------------------------
+# collection
+# ---------------------------------------------------------------------------
+
+
+def _jpeg_segments(data: bytes):
+    """Yield (marker, payload_offset, payload_len) for leading JPEG
+    segments, stopping at SOS (metadata lives before entropy data)."""
+    i = 2
+    n = min(len(data), _SCAN_LIMIT)
+    while i + 4 <= n:
+        if data[i] != 0xFF:
+            return
+        marker = data[i + 1]
+        if marker == 0xD8:
+            i += 2
+            continue
+        if marker in (0xDA, 0xD9):
+            return
+        seglen = struct.unpack(">H", data[i + 2 : i + 4])[0]
+        if seglen < 2 or i + 2 + seglen > n:
+            return
+        yield marker, i + 4, seglen - 2
+        i += 2 + seglen
+
+
+def collect_jpeg(data: bytes) -> SourceMetadata:
+    """ONE marker walk collects Exif, ICC, and XMP together (_jpeg_segments
+    already rejects segments whose declared length runs past EOF, so every
+    payload seen here is complete)."""
+    meta = SourceMetadata()
+    icc_parts: List[tuple] = []
+    try:
+        for marker, off, plen in _jpeg_segments(data):
+            payload = data[off : off + plen]
+            if marker == 0xE2 and payload.startswith(_ICC_HEADER):
+                # seq is 1-based; a profile may span many APP2 segments
+                seq = payload[len(_ICC_HEADER)]
+                icc_parts.append((seq, payload[len(_ICC_HEADER) + 2 :]))
+            elif marker == 0xE1 and payload.startswith(_EXIF_HEADER):
+                if meta.exif_tiff is None:
+                    meta.exif_tiff = reset_tiff_orientation(
+                        payload[len(_EXIF_HEADER) :]
+                    )
+            elif (
+                marker == 0xE1
+                and payload.startswith(_XMP_HEADER)
+                and meta.xmp is None
+            ):
+                meta.xmp = payload[len(_XMP_HEADER) :]
+    except (struct.error, IndexError):
+        return meta
+    if icc_parts:
+        icc_parts.sort(key=lambda part: part[0])
+        meta.icc = b"".join(part[1] for part in icc_parts)
+    return meta
+
+
+def png_orientation(data: bytes) -> int:
+    """EXIF orientation of a PNG's eXIf chunk (1 when absent). IM's
+    -auto-orient honors orientation in ANY container, so the decode path
+    must apply it for PNG sources too, not just JPEG APP1."""
+    try:
+        for ctype, off, clen in _png_chunks(data):
+            if ctype == b"eXIf":
+                return tiff_orientation(data[off : off + clen])
+    except (struct.error, IndexError):
+        return 1
+    return 1
+
+
+def _png_chunks(data: bytes):
+    """Yield (type, data_offset, data_len) for PNG chunks."""
+    if not data.startswith(_PNG_SIG):
+        return
+    i = len(_PNG_SIG)
+    n = min(len(data), _SCAN_LIMIT)
+    while i + 8 <= n:
+        (clen,) = struct.unpack(">I", data[i : i + 4])
+        ctype = data[i + 4 : i + 8]
+        if i + 12 + clen > n:
+            return
+        yield ctype, i + 8, clen
+        if ctype == b"IEND":
+            return
+        i += 12 + clen
+
+
+def collect_png(data: bytes) -> SourceMetadata:
+    meta = SourceMetadata()
+    try:
+        for ctype, off, clen in _png_chunks(data):
+            chunk = data[off : off + clen]
+            if ctype == b"iCCP" and meta.icc is None:
+                # profile-name\0 compression-method(0) deflate-stream
+                zero = chunk.find(b"\x00")
+                if zero < 0 or zero + 2 > len(chunk) or chunk[zero + 1] != 0:
+                    continue
+                try:
+                    meta.icc = zlib.decompress(chunk[zero + 2 :])
+                except zlib.error:
+                    continue
+            elif ctype == b"eXIf" and meta.exif_tiff is None:
+                # eXIf carries the raw TIFF stream directly. Orientation
+                # resets to 1 like the JPEG path — decode applied it to
+                # the pixels (png_orientation above). No size cap here:
+                # PNG chunks allow 2^31 bytes; the APP1 64KB ceiling only
+                # matters when the OUTPUT is JPEG (inject_jpeg).
+                meta.exif_tiff = reset_tiff_orientation(chunk)
+    except (struct.error, IndexError):
+        return meta
+    return meta
+
+
+def collect(data: bytes, mime: str) -> SourceMetadata:
+    """Source bytes -> whatever metadata the container carries."""
+    if mime == "image/jpeg":
+        return collect_jpeg(data)
+    if mime == "image/png":
+        return collect_png(data)
+    return SourceMetadata()
+
+
+# ---------------------------------------------------------------------------
+# injection
+# ---------------------------------------------------------------------------
+
+
+def _icc_app2_train(icc: bytes) -> bytes:
+    """Split a profile into the standard APP2 ICC_PROFILE chunk train."""
+    chunks = [icc[i : i + _ICC_CHUNK] for i in range(0, len(icc), _ICC_CHUNK)]
+    count = len(chunks)
+    if count > 255:
+        return b""  # profile too large for the JPEG chunk scheme
+    out = []
+    for seq, chunk in enumerate(chunks, start=1):
+        payload = _ICC_HEADER + bytes((seq, count)) + chunk
+        out.append(b"\xff\xe2" + struct.pack(">H", 2 + len(payload)) + payload)
+    return b"".join(out)
+
+
+def inject_jpeg(jpeg: bytes, meta: SourceMetadata) -> bytes:
+    """Insert carried metadata after SOI/APP0 (the canonical slot)."""
+    if jpeg[:2] != b"\xff\xd8" or not meta:
+        return jpeg
+    segments = []
+    if meta.exif_tiff is not None:
+        payload = _EXIF_HEADER + meta.exif_tiff
+        if 2 + len(payload) <= 0xFFFF:  # APP1 length-field ceiling
+            segments.append(
+                b"\xff\xe1" + struct.pack(">H", 2 + len(payload)) + payload
+            )
+    if meta.xmp is not None:
+        payload = _XMP_HEADER + meta.xmp
+        if 2 + len(payload) <= 0xFFFF:
+            segments.append(
+                b"\xff\xe1" + struct.pack(">H", 2 + len(payload)) + payload
+            )
+    if meta.icc is not None:
+        segments.append(_icc_app2_train(meta.icc))
+    blob = b"".join(segments)
+    if not blob:
+        return jpeg
+    pos = 2
+    while (
+        pos + 4 <= len(jpeg) and jpeg[pos] == 0xFF and jpeg[pos + 1] == 0xE0
+    ):
+        (seglen,) = struct.unpack(">H", jpeg[pos + 2 : pos + 4])
+        pos += 2 + seglen
+    return jpeg[:pos] + blob + jpeg[pos:]
+
+
+def _png_chunk(ctype: bytes, payload: bytes) -> bytes:
+    crc = zlib.crc32(ctype + payload) & 0xFFFFFFFF
+    return struct.pack(">I", len(payload)) + ctype + payload + struct.pack(">I", crc)
+
+
+def inject_png(png: bytes, meta: SourceMetadata) -> bytes:
+    """Insert iCCP/eXIf right after IHDR (iCCP must precede PLTE/IDAT)."""
+    if not png.startswith(_PNG_SIG) or not meta:
+        return png
+    chunks = []
+    if meta.icc is not None:
+        chunks.append(
+            _png_chunk(b"iCCP", b"ICC Profile\x00\x00" + zlib.compress(meta.icc))
+        )
+    if meta.exif_tiff is not None:
+        chunks.append(_png_chunk(b"eXIf", meta.exif_tiff))
+    blob = b"".join(chunks)
+    if not blob:
+        return png
+    # IHDR is always first: signature + len(4) type(4) data(13) crc(4)
+    pos = len(_PNG_SIG) + 8 + 13 + 4
+    if len(png) < pos:
+        return png
+    return png[:pos] + blob + png[pos:]
+
+
+def inject(content: bytes, extension: str, meta: SourceMetadata) -> bytes:
+    if extension == "jpg":
+        return inject_jpeg(content, meta)
+    if extension == "png":
+        return inject_png(content, meta)
+    return content

@@ -25,7 +25,6 @@ import numpy as np
 
 from flyimg_tpu.appconfig import AppParameters
 from flyimg_tpu.codecs import decode, encode, media_info
-from flyimg_tpu.codecs.exif import extract_app1, inject_app1
 from flyimg_tpu.exceptions import ServiceUnavailableException
 from flyimg_tpu.ops.compose import run_plan
 from flyimg_tpu.service.input_source import load_source
@@ -130,9 +129,18 @@ class ImageHandler:
 
     def _faces(self):
         if self._face_backend is None:
-            from flyimg_tpu.models import facefind
+            from flyimg_tpu.models.faces import make_face_backend
 
-            self._face_backend = facefind
+            # honor the handler's OWN config first (a caller that set
+            # face_backend in params but not the kwarg must get what it
+            # configured); the default is the registry's auto chain
+            # (haar -> blazeface -> no-op), NOT the skin proposer —
+            # reference fallback semantics are "face options no-op when no
+            # real detector exists" (FaceDetectProcessor.php:24)
+            self._face_backend = make_face_backend(
+                str(self.params.by_key("face_backend", "auto")),
+                self.params.by_key("face_checkpoint"),
+            )
         return self._face_backend
 
     def process_image(
@@ -320,6 +328,55 @@ class ImageHandler:
             jnp.clip(jnp.round(out), 0.0, 255.0).astype(jnp.uint8)
         )
 
+    def _encode_one(
+        self,
+        frame: np.ndarray,
+        spec: OutputSpec,
+        options: OptionsBag,
+        *,
+        alpha,
+    ) -> bytes:
+        """Encode a finished frame. JPEG outputs ride the native encode
+        pool through the host-codec controller when available, so
+        concurrent misses pay the trellis DP in parallel on C worker
+        threads (the encode-side twin of _decode_batched); everything else
+        (and every fallback) uses the single-image encode()."""
+        from flyimg_tpu.codecs import (
+            batch_jpeg_encode,
+            native_codec,
+            parse_sampling_factor,
+        )
+
+        quality = options.int_option("quality", 90) or 90
+        mozjpeg = str(options.get_option("mozjpeg")) == "1"
+        sampling_factor = str(options.get_option("sampling-factor") or "1x1")
+        if (
+            self.codec_batcher is not None
+            and spec.extension == "jpg"
+            and alpha is None
+            and native_codec.get_pool() is not None
+        ):
+            # validate the grammar HERE so a bad sf_ raises in the request
+            # thread (typed 400), not inside the shared pool runner
+            sampling = parse_sampling_factor(sampling_factor)
+            blob = self.codec_batcher.submit_aux(
+                ("jpegenc", quality, sampling, mozjpeg),
+                (np.ascontiguousarray(frame), quality, sampling, mozjpeg),
+                batch_jpeg_encode,
+            ).result(timeout=self.DEVICE_RESULT_TIMEOUT_S)
+            if blob is not None:
+                return blob
+        return encode(
+            frame,
+            spec.extension,
+            quality=quality,
+            webp_lossless=bool(options.truthy("webp-lossless")),
+            mozjpeg=mozjpeg,
+            sampling_factor=sampling_factor,
+            strip=options.truthy("strip"),
+            alpha=alpha,
+        )
+
     def _decode_batched(self, data: bytes, hint, info):
         """JPEG fast path through the native DecodePool: concurrent misses
         sharing a DCT prescale decode as ONE pool batch on the host-codec
@@ -379,9 +436,35 @@ class ImageHandler:
         spec.command_repr = repr(plan)
 
         frames = [decoded.rgb]
-        durations = None
+        anim: Optional[_Animation] = None
         if is_animated_gif_out and decoded.n_frames > 1:
-            frames, durations = _decode_all_frames(data)
+            anim = _decode_all_frames(data)
+            frames = anim.frames
+            if anim.alphas is not None:
+                # transparent animation: the device transform runs on rgb
+                # flattened over bg_ (what opaque viewers composite), and
+                # the alpha planes ride through extra frames under a
+                # GEOMETRY-ONLY variant of the plan: resample/extent/crop
+                # must track the pixels, but value ops (dither, grayscale,
+                # sharpen) would corrupt alpha, and fills (rotate corners,
+                # extent pads) become opaque background in the output — so
+                # the alpha plan strips value ops and fills with 255
+                a_list = anim.alphas
+                bg = np.asarray(
+                    plan.background or (255, 255, 255), np.float32
+                )
+                flat = []
+                for frame, alpha_plane in zip(frames, a_list):
+                    a = alpha_plane[..., None].astype(np.float32) / 255.0
+                    flat.append(
+                        np.round(
+                            frame.astype(np.float32) * a + bg * (1.0 - a)
+                        ).astype(np.uint8)
+                    )
+                frames = flat + [
+                    np.repeat(alpha_plane[..., None], 3, axis=2)
+                    for alpha_plane in a_list
+                ]
 
         # Alpha survives to the output only when no op changes geometry and
         # the format carries it; everywhere else flatten the RAW rgb over
@@ -393,7 +476,7 @@ class ImageHandler:
             and plan.extract is None and plan.rotate is None
             and not plan.smart_crop
             and not plan.face_blur and not plan.face_crop
-            and not (is_animated_gif_out and decoded.n_frames > 1)
+            and anim is None
             and spec.extension in ("png", "webp")
         )
         if decoded.alpha is not None and not keeps_alpha and len(frames) == 1:
@@ -409,12 +492,26 @@ class ImageHandler:
         # submit every frame before waiting on any: coalesced GIF frames
         # share one program identity, so the batcher runs them as a single
         # vmapped launch instead of n_frames serial device round-trips
+        alpha_start = (
+            len(anim.frames)
+            if anim is not None and anim.alphas is not None
+            else None
+        )
         staged = []
-        for frame in frames:
+        for idx, frame in enumerate(frames):
             fh, fw = frame.shape[:2]
             frame_plan = plan if (fw, fh) == plan.src_size else build_plan(
                 options, fw, fh
             )
+            if alpha_start is not None and idx >= alpha_start:
+                from dataclasses import replace as _replace
+
+                frame_plan = _replace(
+                    frame_plan,
+                    colorspace=None, monochrome=False,
+                    unsharp=None, sharpen=None, blur=None,
+                    background=(255, 255, 255),
+                )
             tiled = self._tiled_or_none(frame, frame_plan)
             if tiled is not None:
                 staged.append(tiled)
@@ -482,32 +579,42 @@ class ImageHandler:
                 out_frames[0].shape[:2] == decoded.alpha.shape:
             alpha = decoded.alpha
 
-        if len(out_frames) > 1:
-            content = _encode_gif_animation(out_frames, durations)
-        else:
-            content = encode(
-                out_frames[0],
-                spec.extension,
-                quality=options.int_option("quality", 90) or 90,
-                webp_lossless=bool(options.truthy("webp-lossless")),
-                mozjpeg=str(options.get_option("mozjpeg")) == "1",
-                sampling_factor=str(options.get_option("sampling-factor") or "1x1"),
-                strip=options.truthy("strip"),
-                alpha=alpha,
+        if anim is not None and len(out_frames) > 1:
+            n = len(anim.frames)
+            out_alphas = None
+            if anim.alphas is not None:
+                # the second half of the staged frames are the transformed
+                # alpha planes; GIF transparency is binary, so threshold
+                # at 128 (IM's behavior quantizing resampled RGBA to GIF)
+                out_alphas = [
+                    np.where(af[..., 0] >= 128, 255, 0).astype(np.uint8)
+                    for af in out_frames[n:]
+                ]
+                out_frames = out_frames[:n]
+            content = _encode_gif_animation(
+                out_frames, out_alphas, anim.durations, anim.loop
             )
-        # st_0: the reference preserves source metadata when -strip is off
-        # (ImageProcessor.php:97-99); raw-pixel decode loses it, so graft
-        # the source EXIF back (orientation reset to 1 — already baked
-        # into the pixels) for jpeg->jpeg outputs
+        else:
+            content = self._encode_one(
+                out_frames[0], spec, options, alpha=alpha
+            )
+        # st_0: the reference preserves ALL source metadata when -strip is
+        # off (ImageProcessor.php:97-99) — EXIF, ICC profile, XMP. A
+        # raw-pixel decode loses them, so collect from the source container
+        # (JPEG APPn / PNG iCCP+eXIf) and graft into the output (JPEG APPn
+        # train / PNG chunks). EXIF orientation is reset to 1 — the
+        # rotation is baked into the pixels. WebP/GIF outputs still drop
+        # metadata (no RIFF/GIF extension surgery yet).
         if (
             not options.truthy("strip")
-            and spec.extension == "jpg"
-            and decoded.mime == "image/jpeg"
+            and spec.extension in ("jpg", "png")
             and len(out_frames) == 1
         ):
-            app1 = extract_app1(data)
-            if app1 is not None:
-                content = inject_app1(content, app1)
+            from flyimg_tpu.codecs import metadata as meta_mod
+
+            meta = meta_mod.collect(data, decoded.mime)
+            if meta:
+                content = meta_mod.inject(content, spec.extension, meta)
         timings["encode"] = time.perf_counter() - t
 
         # rf_1 debug header payload (reference `identify` line via the
@@ -525,35 +632,85 @@ class ImageHandler:
         return content
 
 
-def _decode_all_frames(data: bytes):
-    """All frames of an animated GIF, coalesced (reference -coalesce,
-    ImageProcessor.php:74-76), plus per-frame durations."""
+@dataclass
+class _Animation:
+    """Coalesced animated-GIF state (reference -coalesce,
+    ImageProcessor.php:74-76)."""
+
+    frames: list            # [h, w, 3] uint8 per frame, composited
+    alphas: Optional[list]  # [h, w] uint8 per frame; None = fully opaque
+    durations: list         # ms per frame
+    loop: Optional[int]     # NETSCAPE loop count; None = no ext (play once)
+
+
+def _decode_all_frames(data: bytes) -> _Animation:
+    """All frames of an animated GIF, coalesced with per-frame disposal
+    and transparency respected (PIL's GIF plugin composites partial frames
+    and handles disposal 2 'restore background' / 3 'restore previous';
+    the RGBA convert keeps transparent regions transparent instead of
+    baking in a palette color). Loop count is carried through — the old
+    hardcoded loop=0 turned play-once GIFs into infinite loops."""
     import io
 
     from PIL import Image, ImageSequence
 
     img = Image.open(io.BytesIO(data))
-    frames = []
-    durations = []
+    loop = img.info.get("loop")  # 0 = infinite; absent = play once
+    frames, alphas, durations = [], [], []
+    any_alpha = False
     for frame in ImageSequence.Iterator(img):
         durations.append(frame.info.get("duration", 100))
-        frames.append(np.asarray(frame.convert("RGB")).copy())
-    return frames, durations
+        rgba = np.asarray(frame.convert("RGBA"))
+        frames.append(np.ascontiguousarray(rgba[..., :3]))
+        alpha = rgba[..., 3]
+        if alpha.min() < 255:
+            any_alpha = True
+        alphas.append(np.ascontiguousarray(alpha))
+    return _Animation(
+        frames=frames,
+        alphas=alphas if any_alpha else None,
+        durations=durations,
+        loop=loop,
+    )
 
 
-def _encode_gif_animation(frames, durations) -> bytes:
+def _encode_gif_animation(frames, alphas, durations, loop) -> bytes:
+    """Re-assemble a GIF. Transparency needs explicit palette surgery
+    (PIL's RGBA->GIF save silently drops it): quantize to 255 colors and
+    reserve index 255 as the transparent index, alpha thresholded at 128
+    (GIF transparency is binary — the same quantization IM applies to
+    resampled RGBA). Loop is emitted only when the source had a NETSCAPE
+    extension; writing loop=0 unconditionally would turn play-once GIFs
+    into infinite loops."""
     import io
 
     from PIL import Image
 
-    pil_frames = [Image.fromarray(f) for f in frames]
+    pil_frames = []
+    for i, frame in enumerate(frames):
+        pil = Image.fromarray(frame)
+        if alphas is not None:
+            p = pil.convert("P", palette=Image.Palette.ADAPTIVE, colors=255)
+            mask = Image.fromarray(
+                np.where(alphas[i] < 128, 255, 0).astype(np.uint8)
+            )
+            p.paste(255, mask)
+            p.info["transparency"] = 255
+            pil = p
+        pil_frames.append(pil)
     buf = io.BytesIO()
+    kwargs = {}
+    if loop is not None:
+        kwargs["loop"] = loop
+    if alphas is not None:
+        # frames with holes must not stack on each other
+        kwargs.update(disposal=2, transparency=255, optimize=False)
     pil_frames[0].save(
         buf,
         "GIF",
         save_all=True,
         append_images=pil_frames[1:],
         duration=durations or 100,
-        loop=0,
+        **kwargs,
     )
     return buf.getvalue()

@@ -234,14 +234,32 @@ def test_trellis_encode_subsampling_dims():
 
     if not native_codec.available():
         pytest.skip("fastcodec not built")
-    rng = np.random.default_rng(4)
-    # odd dims exercise the chroma padding/rounding paths
-    img = rng.integers(0, 256, (123, 157, 3), dtype=np.uint8)
-    for sub444 in (True, False):
-        blob = native_codec.jpeg_encode_trellis(img, 85, subsampling_444=sub444)
-        assert blob is not None
+    # smooth photographic-like content (gradients): chroma subsampling
+    # should cost little PSNR here, so a low score flags a plane-geometry
+    # bug (garbled chroma) rather than ordinary subsampling loss. Odd dims
+    # exercise the chroma padding/rounding paths; the sampling set covers
+    # the IM -sampling-factor geometries the reference forwards
+    # (1x1=4:4:4, 2x2=4:2:0, 2x1=4:2:2, 1x2=4:4:0, 4x1=4:1:1)
+    yy, xx = np.mgrid[0:123, 0:157]
+    img = np.stack(
+        [
+            (xx * 255 / 156),
+            (yy * 255 / 122),
+            ((xx + yy) * 255 / 278),
+        ],
+        axis=-1,
+    ).astype(np.uint8)
+    for sampling in ((1, 1), (2, 2), (2, 1), (1, 2), (4, 1)):
+        blob = native_codec.jpeg_encode_trellis(img, 85, sampling=sampling)
+        assert blob is not None, sampling
         out = Image.open(io.BytesIO(blob))
-        assert out.size == (157, 123)
+        assert out.size == (157, 123), sampling
+        dec = np.asarray(out.convert("RGB")).astype(np.float64)
+        mse = np.mean((dec - img.astype(np.float64)) ** 2)
+        assert 10 * np.log10(255.0**2 / mse) > 30.0, sampling
+    # invalid factor pairs are rejected, not silently coerced
+    assert native_codec.jpeg_encode_trellis(img, 85, sampling=(3, 3)) is None
+    assert native_codec.jpeg_encode(img, 85, sampling=(5, 1)) is None
 
 
 def test_moz_flag_switches_encoder(tmp_path):
@@ -332,14 +350,16 @@ def test_exif_malformed_offsets_never_raise_or_corrupt():
     """EXIF IFD offsets are attacker-controlled. Two crafted cases:
     (a) the 0x0112 tag id is readable but its value field lies past EOF —
     orientation must fall back to 1, not raise struct.error (which would
-    turn every request on that image into a 500);
+    turn every request on that image into a 500), and the st_0 graft must
+    skip a segment whose declared length runs past EOF (a short copy
+    would desync declared vs actual bytes — a corrupt output JPEG);
     (b) the IFD offset points PAST the APP1 segment into trailing file
-    bytes — extract_app1 must not slice-assign beyond the copied segment,
-    which would desync the grafted segment's declared length from its
-    actual bytes (serving a corrupt JPEG on st_0)."""
+    bytes — the out-of-segment entry must not be trusted, and any grafted
+    segment's declared length must equal its actual bytes."""
     import struct as _s
 
-    from flyimg_tpu.codecs.exif import extract_app1, jpeg_orientation
+    from flyimg_tpu.codecs.exif import jpeg_orientation
+    from flyimg_tpu.codecs.metadata import collect_jpeg, inject_jpeg
 
     def app1(payload: bytes, declared_len: int) -> bytes:
         return b"\xff\xe1" + _s.pack(">H", declared_len) + payload
@@ -351,9 +371,7 @@ def test_exif_malformed_offsets_never_raise_or_corrupt():
     declared = 2 + len(payload) + 8  # claims the full entry is present
     truncated = b"\xff\xd8" + app1(payload, declared)
     assert jpeg_orientation(truncated) == 1
-    # declared seglen runs past EOF: grafting a short copy would desync
-    # declared vs actual bytes, so the graft must be skipped outright
-    assert extract_app1(truncated) is None
+    assert collect_jpeg(truncated).exif_tiff is None
 
     # (b) IFD offset escapes the segment: entry lives in trailing bytes
     tiff_esc = b"II*\x00" + _s.pack("<I", 64)  # IFD far past the segment
@@ -365,8 +383,177 @@ def test_exif_malformed_offsets_never_raise_or_corrupt():
     crafted = b"\xff\xd8" + seg + trailer + b"\xff\xd9"
     # the out-of-segment entry must not be trusted for rotation...
     assert jpeg_orientation(crafted) == 1
-    grafted = extract_app1(crafted)
-    # ...and the grafted segment's declared length must equal its bytes
-    if grafted is not None:
-        declared_len = _s.unpack(">H", grafted[2:4])[0]
-        assert len(grafted) == 2 + declared_len
+    # ...and any grafted APP1 must declare exactly the bytes it carries
+    meta = collect_jpeg(crafted)
+    base = encode(_img(seed=9), "jpg")
+    grafted = inject_jpeg(base, meta)
+    pos = 2
+    while pos + 4 <= len(grafted) and grafted[pos] == 0xFF:
+        marker = grafted[pos + 1]
+        if marker in (0xD8,):
+            pos += 2
+            continue
+        if marker in (0xDA, 0xD9):
+            break
+        seglen = _s.unpack(">H", grafted[pos + 2 : pos + 4])[0]
+        assert pos + 2 + seglen <= len(grafted)
+        pos += 2 + seglen
+
+
+def test_parse_sampling_factor_grammar():
+    """IM -sampling-factor grammar: geometry HxV and ratio forms map to
+    luma factor pairs; garbage raises instead of silently coercing
+    (reference forwards the raw value to convert, which errors —
+    ImageProcessor.php:105)."""
+    import pytest as _pytest
+
+    from flyimg_tpu.codecs import parse_sampling_factor
+    from flyimg_tpu.exceptions import InvalidArgumentException
+
+    assert parse_sampling_factor("1x1") == (1, 1)
+    assert parse_sampling_factor("2x2") == (2, 2)
+    assert parse_sampling_factor("2x1") == (2, 1)
+    assert parse_sampling_factor("1x2") == (1, 2)
+    assert parse_sampling_factor("4:4:4") == (1, 1)
+    assert parse_sampling_factor("4:2:0") == (2, 2)
+    assert parse_sampling_factor("4:2:2") == (2, 1)
+    assert parse_sampling_factor("4:1:1") == (4, 1)
+    assert parse_sampling_factor(None) == (1, 1)
+    assert parse_sampling_factor("") == (1, 1)
+    for bad in ("abc", "0x1", "5x1", "3x3", "4x4", "4:3:2"):
+        with _pytest.raises(InvalidArgumentException):
+            parse_sampling_factor(bad)
+
+
+def test_pool_encode_batch_matches_single_encode():
+    """The pooled batch encode must produce byte-identical output to the
+    single-image entry points for both the trellis and plain paths."""
+    from flyimg_tpu.codecs import native_codec
+
+    if not native_codec.available():
+        pytest.skip("fastcodec not built")
+    rng = np.random.default_rng(11)
+    frames = [
+        np.clip(rng.normal(120, 40, (90 + 8 * i, 130, 3)), 0, 255).astype(np.uint8)
+        for i in range(5)
+    ]
+    pool = native_codec.DecodePool(2)
+    try:
+        for trellis in (True, False):
+            batched = pool.encode_batch(
+                frames, 85, trellis=trellis, sampling=(2, 2)
+            )
+            for frame, blob in zip(frames, batched):
+                if trellis:
+                    single = native_codec.jpeg_encode_trellis(
+                        frame, 85, sampling=(2, 2)
+                    )
+                else:
+                    single = native_codec.jpeg_encode(
+                        frame, 85, optimize=True, progressive=True,
+                        sampling=(2, 2),
+                    )
+                assert blob == single
+    finally:
+        pool.close()
+
+
+def _icc_profile_bytes():
+    """A real (tiny) ICC profile: PIL ships sRGB via ImageCms."""
+    from PIL import ImageCms
+
+    return ImageCms.ImageCmsProfile(ImageCms.createProfile("sRGB")).tobytes()
+
+
+def test_st0_metadata_carry_jpeg_and_png(tmp_path):
+    """st_0 (default) preserves EXIF + ICC + XMP like the reference's
+    no-strip convert (ImageProcessor.php:97-99), across jpeg->jpeg,
+    jpeg->png, png->jpeg, png->png; the default (strip: 1, reference
+    parameters.yml:97) drops everything."""
+    from PIL import Image as PILImage
+
+    from flyimg_tpu.appconfig import AppParameters
+    from flyimg_tpu.service.handler import ImageHandler
+    from flyimg_tpu.storage import make_storage
+
+    params = AppParameters(
+        {"upload_dir": str(tmp_path / "u"), "tmp_dir": str(tmp_path / "t")}
+    )
+    handler = ImageHandler(make_storage(params), params)
+
+    icc = _icc_profile_bytes()
+    rng = np.random.default_rng(21)
+    arr = rng.integers(0, 255, (120, 160, 3), dtype=np.uint8)
+
+    img = PILImage.fromarray(arr)
+    exif = img.getexif()
+    exif[0x0112] = 6          # orientation: baked into pixels, tag reset
+    exif[0x010F] = "acme-cam"  # Make: must survive verbatim
+    jpg_src = str(tmp_path / "src.jpg")
+    img.save(jpg_src, "JPEG", quality=92, exif=exif, icc_profile=icc)
+    png_src = str(tmp_path / "src.png")
+    img.save(png_src, "PNG", exif=exif, icc_profile=icc)
+
+    for src, out_fmt in [
+        (jpg_src, "jpg"), (jpg_src, "png"), (png_src, "jpg"), (png_src, "png"),
+    ]:
+        result = handler.process_image(f"w_100,o_{out_fmt},st_0", src)
+        out = PILImage.open(io.BytesIO(result.content))
+        out.load()
+        assert out.info.get("icc_profile") == icc, (src, out_fmt)
+        carried = out.getexif()
+        assert carried[0x010F] == "acme-cam", (src, out_fmt)
+        # orientation was applied to pixels (jpeg decode path), so the
+        # carried tag must not instruct viewers to rotate again
+        assert carried.get(0x0112, 1) == 1, (src, out_fmt)
+
+    stripped = handler.process_image("w_100,o_jpg", jpg_src)
+    sout = PILImage.open(io.BytesIO(stripped.content))
+    sout.load()
+    assert "icc_profile" not in sout.info
+    assert 0x010F not in sout.getexif()
+
+
+def test_st0_multisegment_icc_round_trip(tmp_path):
+    """ICC profiles larger than one APP2 segment (65519 bytes) must
+    re-assemble on collect and re-split on inject byte-identically."""
+    from flyimg_tpu.codecs import metadata as meta_mod
+
+    icc = bytes(range(256)) * 600  # ~150 KB -> 3 APP2 chunks
+    meta = meta_mod.SourceMetadata(icc=icc)
+    base = encode(_img(seed=8), "jpg", quality=90)
+    grafted = meta_mod.inject_jpeg(base, meta)
+    back = meta_mod.collect_jpeg(grafted)
+    assert back.icc == icc
+    # and PIL agrees the train parses as one profile
+    from PIL import Image as PILImage
+
+    out = PILImage.open(io.BytesIO(grafted))
+    out.load()
+    assert out.info.get("icc_profile") == icc
+
+
+def test_png_exif_orientation_native_and_pil_paths_agree(monkeypatch):
+    """PNG eXIf orientation must be applied exactly ONCE on both decode
+    paths: the native path applies it explicitly (_orient_png), the PIL
+    fallback already runs ImageOps.exif_transpose — double-applying
+    yielded a 180-degree-rotated image."""
+    from PIL import Image as PILImage
+
+    from flyimg_tpu.codecs import native_codec
+
+    arr = _img(h=40, w=60, seed=13)
+    img = PILImage.fromarray(arr)
+    exif = img.getexif()
+    exif[0x0112] = 6  # 90-degree rotation -> dims swap
+    buf = io.BytesIO()
+    img.save(buf, "PNG", exif=exif)
+    data = buf.getvalue()
+
+    native = decode(data)
+    assert native.rgb.shape[:2] == (60, 40)
+
+    monkeypatch.setattr(native_codec, "available", lambda: False)
+    fallback = decode(data)
+    assert fallback.rgb.shape[:2] == (60, 40)
+    np.testing.assert_array_equal(native.rgb, fallback.rgb)
