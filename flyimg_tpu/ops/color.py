@@ -19,6 +19,8 @@ import numpy as np
 
 # Rec.709 luma — what IM uses for '-colorspace Gray' (sRGB-companded luma)
 LUMA_WEIGHTS = (0.212656, 0.715158, 0.072186)
+# Rec.601 luma — IM's '-colorspace Rec601Luma' (SD-video weights)
+LUMA_WEIGHTS_601 = (0.298839, 0.586811, 0.114350)
 
 # canonical 8x8 Bayer matrix, values 0..63 — a HOST constant: a module-level
 # jnp.array would initialize the device backend at import time, which wedges
@@ -38,10 +40,11 @@ _BAYER8 = np.array(
 )
 
 
-def to_grayscale(image: jnp.ndarray) -> jnp.ndarray:
-    """[..., H, W, 3] -> same shape, all channels = Rec709 luma."""
-    weights = jnp.array(LUMA_WEIGHTS, dtype=image.dtype)
-    luma = jnp.tensordot(image, weights, axes=([-1], [0]))
+def to_grayscale(image: jnp.ndarray, weights=LUMA_WEIGHTS) -> jnp.ndarray:
+    """[..., H, W, 3] -> same shape, all channels = luma under ``weights``
+    (Rec709 for '-colorspace Gray', LUMA_WEIGHTS_601 for Rec601Luma)."""
+    w = jnp.array(weights, dtype=image.dtype)
+    luma = jnp.tensordot(image, w, axes=([-1], [0]))
     return jnp.broadcast_to(luma[..., None], image.shape)
 
 

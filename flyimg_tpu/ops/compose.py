@@ -128,6 +128,10 @@ def make_program_fn(
             )
         if plan.colorspace == "gray":
             x = to_grayscale(x)
+        elif plan.colorspace == "gray601":
+            from flyimg_tpu.ops.color import LUMA_WEIGHTS_601
+
+            x = to_grayscale(x, LUMA_WEIGHTS_601)
         if plan.monochrome:
             x = monochrome_dither(x)
         if plan.rotate is not None:

@@ -23,6 +23,7 @@ import re
 from dataclasses import dataclass, replace
 from typing import Optional, Tuple
 
+from flyimg_tpu.exceptions import InvalidArgumentException
 from flyimg_tpu.spec.colors import parse_color
 from flyimg_tpu.spec.geometry import (
     GeometryPlan,
@@ -310,10 +311,29 @@ def build_plan(
     # .php:264-272); both are the same resample here (thumbnail only adds
     # metadata stripping, which is a host/encode concern).
 
-    colorspace_raw = str(options.get_option("colorspace") or "").lower()
+    # normalize IM's spelling variants (LinearGray / linear-gray / Linear
+    # Gray all name one colorspace in IM's MagickCore option table)
+    colorspace_raw = re.sub(
+        r"[^a-z0-9]", "", str(options.get_option("colorspace") or "").lower()
+    )
     colorspace = None
     if colorspace_raw in ("gray", "grey", "grayscale", "lineargray", "rec709luma"):
         colorspace = "gray"
+    elif colorspace_raw == "rec601luma":
+        colorspace = "gray601"  # SD-video luma weights, distinct from 709
+    elif colorspace_raw in ("", "none", "srgb", "rgb"):
+        # sRGB/RGB are the pipeline's native space — IM's -colorspace
+        # there is an (effective) identity on 8-bit sRGB input
+        colorspace = None
+    else:
+        # every other IM colorspace (cmyk, lab, hsl, ...) would change the
+        # stored sample meaning; refusing loudly beats the old silent
+        # no-op, which served sRGB bytes while the URL claimed otherwise
+        # (reference forwards the value to convert, ImageProcessor.php:88)
+        raise InvalidArgumentException(
+            f"unsupported colorspace {colorspace_raw!r} (supported: gray/"
+            "grey/grayscale/lineargray/rec601luma/rec709luma, srgb, rgb)"
+        )
 
     monochrome = options.truthy("monochrome")
 
