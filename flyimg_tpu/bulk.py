@@ -57,7 +57,6 @@ def bulk_process(
     ``quality`` overrides the encode quality unless the options string
     itself carries an explicit ``q_``."""
     from flyimg_tpu.appconfig import AppParameters
-    from flyimg_tpu.models.faces import make_face_backend
     from flyimg_tpu.runtime.batcher import BatchController
     from flyimg_tpu.service.handler import ImageHandler
     from flyimg_tpu.service.output_image import EXT_TO_MIME, OutputSpec
@@ -88,15 +87,21 @@ def bulk_process(
         params=params,
         batcher=batcher,
         codec_batcher=codec_batcher,
-        face_backend=make_face_backend(
-            str(params.by_key("face_backend", "auto")),
-            params.by_key("face_checkpoint"),
-        ),
+        # face backend resolves lazily inside the handler (from the same
+        # params) only when a face option actually runs — no cascade /
+        # checkpoint load for plain resize sweeps
     )
+
+    # the SAME OptionsBag configuration serving uses (handler.py): an
+    # operator's options_keys/default_options/separator overrides must
+    # mean the same thing in offline sweeps or byte-parity breaks
+    options_keys = params.by_key("options_keys")
+    default_options = params.by_key("default_options")
+    separator = params.by_key("options_separator", ",")
 
     ext = "jpg" if out_format in ("jpg", "jpeg") else out_format
     explicit_quality = any(
-        seg.startswith("q_") for seg in options_str.split(",")
+        seg.startswith("q_") for seg in options_str.split(separator)
     )
     failed = 0
     t0 = time.perf_counter()
@@ -107,7 +112,12 @@ def bulk_process(
             data = fh.read()
         # fresh bag per file: plan building and the transform read options
         # concurrently across worker threads, and some accessors mutate
-        options = OptionsBag(options_str)
+        options = OptionsBag(
+            options_str,
+            options_keys=options_keys,
+            default_options=default_options,
+            separator=separator,
+        )
         if quality is not None and not explicit_quality:
             options.set_option("quality", int(quality))
         stem = os.path.splitext(name)[0]

@@ -40,17 +40,21 @@ class S3Storage(Storage):
         self._warned_403 = False
 
     @staticmethod
-    def _is_not_found(exc: Exception) -> bool:
-        """Only genuine not-found responses mean "cache miss". Anything
-        else (throttling, network, AccessDenied) must PROPAGATE: treating
-        an S3 outage as a miss would silently recompute + rewrite every
-        request — a cost amplification with no error signal. Duck-typed on
-        botocore ClientError's response shape so the boto3 import stays
-        gated."""
-        code = ""
+    def _error_code(exc: Exception) -> str:
+        """botocore ClientError's Error.Code, duck-typed so the boto3
+        import stays gated; '' when the shape doesn't match."""
         response = getattr(exc, "response", None)
         if isinstance(response, dict):
-            code = str(response.get("Error", {}).get("Code", ""))
+            return str(response.get("Error", {}).get("Code", ""))
+        return ""
+
+    @classmethod
+    def _is_not_found(cls, exc: Exception) -> bool:
+        """Only genuine not-found responses mean "cache miss". Anything
+        else (throttling, network) must PROPAGATE: treating an S3 outage
+        as a miss would silently recompute + rewrite every request — a
+        cost amplification with no error signal."""
+        code = cls._error_code(exc)
         if code in ("404", "NoSuchKey", "NotFound"):
             return True
         # 403/AccessDenied is S3's documented answer for a MISSING key —
@@ -109,9 +113,7 @@ class S3Storage(Storage):
             obj = self._client.get_object(Bucket=self.bucket, Key=name)
         except Exception as exc:
             if self._is_not_found(exc):
-                code = str(
-                    getattr(exc, "response", {}).get("Error", {}).get("Code", "")
-                ) if isinstance(getattr(exc, "response", None), dict) else ""
+                code = self._error_code(exc)
                 if code in ("403", "AccessDenied") and not self._warned_403:
                     self._warned_403 = True
                     import logging
