@@ -76,23 +76,32 @@ def decode(
         elif info.mime == "image/webp" and frame == 0:
             decoded = native_codec.webp_decode_auto(data)
             if decoded is not None:
-                return _split_alpha(decoded, "image/webp")
+                return _orient_container(
+                    _split_alpha(decoded, "image/webp"), data, "webp"
+                )
         elif info.mime == "image/png":
             decoded = native_codec.png_decode(data)
             if decoded is not None:
-                return _orient_png(_split_alpha(decoded, "image/png"), data)
-    # NOTE: no _orient_png here — the PIL fallback already runs
-    # ImageOps.exif_transpose (pil_codec.py:76), which honors PNG eXIf;
-    # applying it again would double-rotate
+                return _orient_container(
+                    _split_alpha(decoded, "image/png"), data, "png"
+                )
+    # NOTE: no orientation here — the PIL fallback already runs
+    # ImageOps.exif_transpose (pil_codec.py:76), which honors PNG eXIf
+    # and WebP EXIF; applying it again would double-rotate
     return pil_codec.decode(data, target_hint=target_hint, frame=frame)
 
 
-def _orient_png(decoded: DecodedImage, data: bytes) -> DecodedImage:
-    """Apply PNG eXIf orientation on the NATIVE decode path (IM's
-    -auto-orient honors orientation in any container; libpng doesn't)."""
-    from flyimg_tpu.codecs.metadata import png_orientation
+def _orient_container(
+    decoded: DecodedImage, data: bytes, container: str
+) -> DecodedImage:
+    """Apply eXIf/EXIF-chunk orientation on the NATIVE decode paths (IM's
+    -auto-orient honors orientation in any container; libpng/libwebp
+    don't)."""
+    from flyimg_tpu.codecs.metadata import png_orientation, webp_orientation
 
-    orientation = png_orientation(data)
+    orientation = (
+        png_orientation(data) if container == "png" else webp_orientation(data)
+    )
     if orientation == 1:
         return decoded
     rgb = np.ascontiguousarray(apply_orientation(decoded.rgb, orientation))

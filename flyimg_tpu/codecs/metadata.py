@@ -14,8 +14,10 @@ container and grafts them into the encoded output:
 - PNG out: iCCP (deflated) + eXIf chunks inserted right after IHDR
   (iCCP must precede PLTE/IDAT, PNG 1.2 section 4.2).
 
-WebP outputs still drop metadata (RIFF/VP8X surgery is not implemented);
-the handler documents that residual gap.
+- WebP in: ICCP/EXIF/XMP chunks of the extended (VP8X) container.
+- WebP out: the simple container is upgraded to VP8X with ICCP before
+  the image chunk and EXIF/XMP after it (chunk order per the WebP
+  container spec), flags set accordingly.
 """
 
 from __future__ import annotations
@@ -170,12 +172,73 @@ def collect_png(data: bytes) -> SourceMetadata:
     return meta
 
 
+def _webp_chunks(data: bytes, limit: Optional[int] = None):
+    """Yield (fourcc, payload_offset, payload_len) for RIFF/WEBP chunks.
+    ``limit`` defaults to the untrusted-source scan budget; the inject
+    path passes len(data) — it walks the pipeline's OWN encoded output,
+    and stopping early there would silently drop the image chunk."""
+    if data[:4] != b"RIFF" or data[8:12] != b"WEBP":
+        return
+    i = 12
+    n = min(len(data), _SCAN_LIMIT if limit is None else limit)
+    while i + 8 <= n:
+        fourcc = data[i : i + 4]
+        (clen,) = struct.unpack("<I", data[i + 4 : i + 8])
+        if i + 8 + clen > n:
+            return
+        yield fourcc, i + 8, clen
+        i += 8 + clen + (clen & 1)  # chunks are 2-byte aligned
+
+
+def collect_webp(data: bytes) -> SourceMetadata:
+    meta = SourceMetadata()
+    try:
+        for fourcc, off, clen in _webp_chunks(data):
+            chunk = data[off : off + clen]
+            if fourcc == b"ICCP" and meta.icc is None:
+                meta.icc = chunk
+            elif fourcc == b"EXIF" and meta.exif_tiff is None:
+                # the spec says raw TIFF, but many writers include the
+                # JPEG-style Exif\0\0 prefix — accept both
+                tiff = (
+                    chunk[len(_EXIF_HEADER) :]
+                    if chunk.startswith(_EXIF_HEADER)
+                    else chunk
+                )
+                meta.exif_tiff = reset_tiff_orientation(tiff)
+            elif fourcc == b"XMP " and meta.xmp is None:
+                meta.xmp = chunk
+    except (struct.error, IndexError):
+        return meta
+    return meta
+
+
+def webp_orientation(data: bytes) -> int:
+    """EXIF orientation of a WebP's EXIF chunk (1 when absent) — IM's
+    -auto-orient honors it; libwebp decode does not."""
+    try:
+        for fourcc, off, clen in _webp_chunks(data):
+            if fourcc == b"EXIF":
+                chunk = data[off : off + clen]
+                tiff = (
+                    chunk[len(_EXIF_HEADER) :]
+                    if chunk.startswith(_EXIF_HEADER)
+                    else chunk
+                )
+                return tiff_orientation(tiff)
+    except (struct.error, IndexError):
+        return 1
+    return 1
+
+
 def collect(data: bytes, mime: str) -> SourceMetadata:
     """Source bytes -> whatever metadata the container carries."""
     if mime == "image/jpeg":
         return collect_jpeg(data)
     if mime == "image/png":
         return collect_png(data)
+    if mime == "image/webp":
+        return collect_webp(data)
     return SourceMetadata()
 
 
@@ -254,9 +317,100 @@ def inject_png(png: bytes, meta: SourceMetadata) -> bytes:
     return png[:pos] + blob + png[pos:]
 
 
+def _webp_canvas_dims(data: bytes):
+    """(width, height) parsed from the image chunk of a simple WebP, or
+    None. VP8: 14-bit dims after the 0x9d012a start code; VP8L: 14-bit
+    minus-one dims packed after the 0x2f signature."""
+    for fourcc, off, clen in _webp_chunks(data, limit=len(data)):
+        chunk = data[off : off + clen]
+        if fourcc == b"VP8 " and clen >= 10:
+            if chunk[3:6] != b"\x9d\x01\x2a":
+                return None
+            (w,) = struct.unpack("<H", chunk[6:8])
+            (h,) = struct.unpack("<H", chunk[8:10])
+            return w & 0x3FFF, h & 0x3FFF
+        if fourcc == b"VP8L" and clen >= 5:
+            if chunk[0] != 0x2F:
+                return None
+            (bits,) = struct.unpack("<I", chunk[1:5])
+            return (bits & 0x3FFF) + 1, ((bits >> 14) & 0x3FFF) + 1
+        if fourcc == b"VP8X" and clen >= 10:
+            w = int.from_bytes(chunk[4:7], "little") + 1
+            h = int.from_bytes(chunk[7:10], "little") + 1
+            return w, h
+    return None
+
+
+def _webp_chunk(fourcc: bytes, payload: bytes) -> bytes:
+    out = fourcc + struct.pack("<I", len(payload)) + payload
+    if len(payload) & 1:
+        out += b"\x00"  # RIFF chunks are 2-byte aligned
+    return out
+
+
+def inject_webp(webp: bytes, meta: SourceMetadata) -> bytes:
+    """Rebuild the container as extended (VP8X) with metadata chunks in
+    spec order: VP8X, ICCP, image data, EXIF, XMP. Existing
+    ICCP/EXIF/XMP chunks (possible when libwebp already emitted VP8X for
+    an alpha image) are replaced by the carried ones."""
+    if webp[:4] != b"RIFF" or webp[8:12] != b"WEBP" or not meta:
+        return webp
+    dims = _webp_canvas_dims(webp)
+    if dims is None:
+        return webp
+    w, h = dims
+    if not (1 <= w <= 1 << 14 and 1 <= h <= 1 << 14):
+        return webp
+
+    image_chunks = []
+    flags = 0
+    for fourcc, off, clen in _webp_chunks(webp, limit=len(webp)):
+        chunk = webp[off : off + clen]
+        if fourcc == b"VP8X":
+            # keep the original's alpha/animation bits (ANIM/ANMF chunks
+            # pass through below); ICC/EXIF/XMP bits are rebuilt
+            if clen >= 1:
+                flags |= chunk[0] & 0x12
+            continue
+        if fourcc in (b"ICCP", b"EXIF", b"XMP "):
+            continue  # rebuilt below
+        if fourcc == b"ALPH":
+            flags |= 0x10
+        if fourcc == b"VP8L" and clen >= 5 and chunk[0] == 0x2F:
+            # lossless carries alpha inside the bitstream: bit 28 of the
+            # header word is alpha_is_used (the container's alpha flag
+            # must agree or strict muxers reject the file)
+            (bits,) = struct.unpack("<I", chunk[1:5])
+            if (bits >> 28) & 1:
+                flags |= 0x10
+        image_chunks.append(_webp_chunk(fourcc, chunk))
+
+    parts = []
+    if meta.icc is not None:
+        flags |= 0x20
+        parts.append(_webp_chunk(b"ICCP", meta.icc))
+    parts.extend(image_chunks)
+    if meta.exif_tiff is not None:
+        flags |= 0x08
+        parts.append(_webp_chunk(b"EXIF", meta.exif_tiff))
+    if meta.xmp is not None:
+        flags |= 0x04
+        parts.append(_webp_chunk(b"XMP ", meta.xmp))
+    vp8x = _webp_chunk(
+        b"VP8X",
+        bytes((flags, 0, 0, 0))
+        + (w - 1).to_bytes(3, "little")
+        + (h - 1).to_bytes(3, "little"),
+    )
+    body = b"WEBP" + vp8x + b"".join(parts)
+    return b"RIFF" + struct.pack("<I", len(body)) + body
+
+
 def inject(content: bytes, extension: str, meta: SourceMetadata) -> bytes:
     if extension == "jpg":
         return inject_jpeg(content, meta)
     if extension == "png":
         return inject_png(content, meta)
+    if extension == "webp":
+        return inject_webp(content, meta)
     return content

@@ -601,13 +601,13 @@ class ImageHandler:
         # st_0: the reference preserves ALL source metadata when -strip is
         # off (ImageProcessor.php:97-99) — EXIF, ICC profile, XMP. A
         # raw-pixel decode loses them, so collect from the source container
-        # (JPEG APPn / PNG iCCP+eXIf) and graft into the output (JPEG APPn
-        # train / PNG chunks). EXIF orientation is reset to 1 — the
-        # rotation is baked into the pixels. WebP/GIF outputs still drop
-        # metadata (no RIFF/GIF extension surgery yet).
+        # (JPEG APPn / PNG iCCP+eXIf / WebP ICCP+EXIF+XMP) and graft into
+        # the output (JPEG APPn train / PNG chunks / WebP VP8X container).
+        # EXIF orientation is reset to 1 — the rotation is baked into the
+        # pixels. GIF outputs drop metadata (the format carries none).
         if (
             not options.truthy("strip")
-            and spec.extension in ("jpg", "png")
+            and spec.extension in ("jpg", "png", "webp")
             and len(out_frames) == 1
         ):
             from flyimg_tpu.codecs import metadata as meta_mod

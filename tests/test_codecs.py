@@ -557,3 +557,46 @@ def test_png_exif_orientation_native_and_pil_paths_agree(monkeypatch):
     fallback = decode(data)
     assert fallback.rgb.shape[:2] == (60, 40)
     np.testing.assert_array_equal(native.rgb, fallback.rgb)
+
+
+def test_st0_metadata_carry_webp(tmp_path):
+    """st_0 to/from WebP: ICC + EXIF survive via VP8X container surgery
+    (jpeg->webp upgrades the simple container; webp source chunks are
+    collected), and orientation is applied once then reset."""
+    from PIL import Image as PILImage
+
+    from flyimg_tpu.appconfig import AppParameters
+    from flyimg_tpu.service.handler import ImageHandler
+    from flyimg_tpu.storage import make_storage
+
+    params = AppParameters(
+        {"upload_dir": str(tmp_path / "u"), "tmp_dir": str(tmp_path / "t")}
+    )
+    handler = ImageHandler(make_storage(params), params)
+    icc = _icc_profile_bytes()
+    rng = np.random.default_rng(31)
+    arr = rng.integers(0, 255, (90, 140, 3), dtype=np.uint8)
+    img = PILImage.fromarray(arr)
+    exif = img.getexif()
+    exif[0x0112] = 6
+    exif[0x010F] = "webp-cam"
+
+    jpg_src = str(tmp_path / "s.jpg")
+    img.save(jpg_src, "JPEG", quality=92, exif=exif, icc_profile=icc)
+    webp_src = str(tmp_path / "s.webp")
+    img.save(webp_src, "WEBP", quality=92, exif=exif, icc_profile=icc)
+
+    for src, out_fmt in [
+        (jpg_src, "webp"), (webp_src, "webp"), (webp_src, "jpg"),
+    ]:
+        result = handler.process_image(f"w_100,o_{out_fmt},st_0", src)
+        out = PILImage.open(io.BytesIO(result.content))
+        out.load()
+        assert out.info.get("icc_profile") == icc, (src, out_fmt)
+        carried = out.getexif()
+        assert carried[0x010F] == "webp-cam", (src, out_fmt)
+        assert carried.get(0x0112, 1) == 1, (src, out_fmt)
+        # orientation 6 -> 90-degree rotation applied to the pixels
+        assert out.size == (100, 156) or out.size[0] < out.size[1], (
+            src, out_fmt, out.size,
+        )
