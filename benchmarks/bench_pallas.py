@@ -1,0 +1,106 @@
+"""Pallas saliency kernel vs the XLA feature-map path, on-chip.
+
+Round-3 verdict item 9: the fused-VMEM saliency kernel
+(ops/pallas_kernels.py) is maintained but unused — serving and bench both
+take the XLA path. This microbench settles it with data: both paths at the
+two shapes that matter (the bench.py flagship field 250x300 and the
+serving prescale work shape), lax.scan steady state, batch 256.
+
+Prints one JSON document {backend, results: [{shape, xla_img_s,
+pallas_img_s, speedup}]}. Run on the real chip (CPU runs use interpret
+mode and say nothing about Mosaic codegen — they exist to smoke the
+harness).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def steady_state(fn, arg, batch, scan=10, launches=4):
+    """Median images/sec of fn at lax.scan steady state (bench.py model)."""
+    import jax
+    import jax.numpy as jnp
+
+    def body(carry, _):
+        zero = jnp.isnan(carry).astype(jnp.uint8)
+        out = fn(arg ^ zero)
+        return carry + out.astype(jnp.float32).sum(), None
+
+    @jax.jit
+    def launch():
+        acc, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=scan)
+        return acc
+
+    jax.block_until_ready(launch())
+    times = []
+    for _ in range(launches):
+        t = time.perf_counter()
+        jax.block_until_ready(launch())
+        times.append(time.perf_counter() - t)
+    return batch / (float(np.median(times)) / scan)
+
+
+def main() -> int:
+    from flyimg_tpu.parallel.mesh import ensure_env_platform
+
+    ensure_env_platform()
+    from bench import _init_backend
+
+    backend = _init_backend()
+
+    import jax
+    import jax.numpy as jnp
+
+    from flyimg_tpu.models.smartcrop import analyse_features, weighted_field
+    from flyimg_tpu.ops.pallas_kernels import saliency_field
+
+    on_tpu = backend == "tpu"
+    batch = 256 if on_tpu else 2
+    shapes = [(250, 300), (128, 192)] if on_tpu else [(32, 48)]
+    scan, launches = (10, 4) if on_tpu else (2, 2)
+    rng = np.random.default_rng(0)
+    results = []
+    for h, w in shapes:
+        images = jax.device_put(
+            rng.integers(0, 255, (batch, h, w, 3), dtype=np.uint8)
+        )
+
+        def xla_path(imgs):
+            return weighted_field(jax.vmap(analyse_features)(imgs))
+
+        def pallas_path(imgs):
+            return saliency_field(imgs)
+
+        row = {"shape": f"{h}x{w}", "batch": batch}
+        try:
+            row["xla_img_s"] = round(
+                steady_state(xla_path, images, batch, scan, launches), 1
+            )
+        except Exception as exc:
+            row["xla_error"] = str(exc)[:200]
+        try:
+            row["pallas_img_s"] = round(
+                steady_state(pallas_path, images, batch, scan, launches), 1
+            )
+        except Exception as exc:
+            row["pallas_error"] = str(exc)[:200]
+        if "xla_img_s" in row and "pallas_img_s" in row:
+            row["speedup"] = round(row["pallas_img_s"] / row["xla_img_s"], 3)
+        results.append(row)
+        print(row, file=sys.stderr)
+
+    doc = {"backend": backend, "results": results}
+    print(json.dumps(doc, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,147 @@
+"""One-shot on-chip evidence capture for a (possibly brief) tunnel window.
+
+The round-3 verdict's top asks are all TPU artifacts: a green BENCH, an
+end-to-end bulk number including decode+encode, p99 under load, a Pallas
+vs XLA decision, and the stage profile explaining the r2->r3 ~4% delta.
+The tunnel in this environment goes down for hours at a stretch, so when
+it IS up, everything must be captured in one command:
+
+    python tools/chip_suite.py [--out benchmarks] [--skip http] ...
+
+Each stage runs in a SUBPROCESS with its own timeout (a mid-stage tunnel
+drop must not wedge the suite; bench.py's probe/fallback hardening runs
+in-process per stage) and appends its JSON to benchmarks/chip_suite_r4.json
+incrementally, so a partial window still leaves committed evidence.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_stage(name, cmd, timeout_s, results, env=None):
+    print(f"== {name}: {' '.join(cmd)}", file=sys.stderr)
+    t0 = time.time()
+    # own session so a timeout can kill the WHOLE process group — e.g.
+    # bench_http --spawn starts a server grandchild that would otherwise
+    # survive the kill, keep the chip locked, and wedge later stages
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=REPO, env={**os.environ, **(env or {})},
+        start_new_session=True,
+    )
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout_s)
+        entry = {
+            "stage": name,
+            "rc": proc.returncode,
+            "seconds": round(time.time() - t0, 1),
+            "stdout_tail": stdout[-4000:],
+            "stderr_tail": stderr[-2000:],
+        }
+    except subprocess.TimeoutExpired as exc:
+        import signal as _signal
+
+        try:
+            os.killpg(proc.pid, _signal.SIGKILL)
+        except OSError:
+            pass
+        # best-effort reap; a tunnel-hung child can be unkillable
+        # (uninterruptible kernel I/O) — don't let it hang the suite
+        try:
+            stdout, stderr = proc.communicate(timeout=10)
+        except Exception:
+            stdout = exc.stdout or ""
+            stderr = exc.stderr or ""
+        if isinstance(stdout, bytes):
+            stdout = stdout.decode(errors="replace")
+        if isinstance(stderr, bytes):
+            stderr = stderr.decode(errors="replace")
+        # keep whatever the stage printed before hanging — partial
+        # evidence is the point of this tool
+        entry = {
+            "stage": name,
+            "rc": -1,
+            "seconds": round(time.time() - t0, 1),
+            "error": f"timeout after {timeout_s}s",
+            "stdout_tail": (stdout or "")[-4000:],
+            "stderr_tail": (stderr or "")[-2000:],
+        }
+    results.append(entry)
+    return entry
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="benchmarks/chip_suite_r4.json")
+    ap.add_argument("--skip", action="append", default=[],
+                    choices=["bench", "ops", "bulk", "http", "pallas"])
+    ap.add_argument("--bulk-src", default="var/bench_images")
+    args = ap.parse_args()
+
+    # stages run with cwd=REPO; resolve our own paths the same way so the
+    # suite behaves identically from any invoking directory
+    args.out = os.path.join(REPO, args.out)
+    args.bulk_src = os.path.join(REPO, args.bulk_src)
+
+    results = []
+
+    def flush():
+        with open(args.out, "w") as fh:
+            json.dump({"when": time.strftime("%F %T"), "stages": results},
+                      fh, indent=1)
+            fh.write("\n")
+
+    py = sys.executable
+    if "bench" not in args.skip:
+        run_stage("bench_headline", [py, "bench.py"], 900, results)
+        flush()
+    if "ops" not in args.skip:
+        run_stage(
+            "device_ops",
+            [py, "benchmarks/bench_ops.py", "--out",
+             "benchmarks/device_ops_r4.json"],
+            1200, results,
+        )
+        flush()
+    if "bulk" not in args.skip and os.path.isdir(args.bulk_src):
+        run_stage(
+            "e2e_bulk",
+            [py, "-m", "flyimg_tpu.bulk", "--src", args.bulk_src,
+             "--out", "var/tmp/bulk_out_r4", "--options",
+             "w_300,h_250,c_1,smc_1", "--format", "jpg", "--workers", "16"],
+            1800, results,
+        )
+        flush()
+    if "http" not in args.skip:
+        run_stage(
+            "http_latency",
+            [py, "tools/bench_http.py", "--spawn", "--burst", "3000",
+             "--conc", "64", "--miss", "256"],
+            1800, results,
+        )
+        flush()
+    if "pallas" not in args.skip:
+        run_stage(
+            "pallas_vs_xla",
+            [py, "benchmarks/bench_pallas.py"],
+            900, results,
+        )
+        flush()
+
+    flush()
+    print(json.dumps({"stages": [
+        {k: e.get(k) for k in ("stage", "rc", "seconds")} for e in results
+    ]}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
