@@ -110,14 +110,19 @@ def anchor_centers() -> np.ndarray:
     return np.asarray(anchors, dtype=np.float32)
 
 
-_ANCHORS = None
+_ANCHORS_NP = None
 
 
 def get_anchors() -> jnp.ndarray:
-    global _ANCHORS
-    if _ANCHORS is None:
-        _ANCHORS = jnp.asarray(anchor_centers())
-    return _ANCHORS
+    """Anchor table as a jnp value. The cache holds the NUMPY array and
+    converts per call: caching the jnp conversion would capture a tracer
+    when the first caller is inside a jit trace, and any later retrace
+    (a new batch bucket) would then reuse that dead tracer
+    (UnexpectedTracerError). As a trace constant the conversion is free."""
+    global _ANCHORS_NP
+    if _ANCHORS_NP is None:
+        _ANCHORS_NP = anchor_centers()
+    return jnp.asarray(_ANCHORS_NP)
 
 
 def init_params(rng: jax.Array) -> Dict[str, Any]:
@@ -163,8 +168,12 @@ def _boxes_from_scores(
     max_faces: int,
 ) -> List[Tuple[int, int, int, int]]:
     """Greedy NMS over decoded anchors -> pixel boxes (shared by the
-    single-image and batched entry points)."""
-    keep = np.argsort(-probs)[: max_faces * 4]
+    single-image and batched entry points). The candidate budget scales
+    with the anchor count: multiscale concatenates several views, whose
+    cross-view duplicates of a strong face would otherwise crowd weaker
+    faces out of a fixed top-64 before NMS dedups them."""
+    n_views = max(1, len(probs) // NUM_ANCHORS)
+    keep = np.argsort(-probs)[: max_faces * 4 * n_views]
     out: List[Tuple[int, int, int, int]] = []
     taken: List[Tuple[float, float, float, float]] = []
     for idx in keep:
@@ -182,6 +191,43 @@ def _boxes_from_scores(
         if x1 > x0 and y1 > y0:
             out.append((x0, y0, x1 - x0, y1 - y0))
     return out
+
+
+#: tile views kick in above this size: a 128^2 network input means a face
+#: spanning < ~15% of a large frame lands below the training scale range
+#: (tools/train_blazeface.py pastes at 15-55%); 0.6-side corner tiles with
+#: 20% overlap bring group-photo heads back into range
+MULTISCALE_MIN_SIDE = 256
+_TILE_FRAC = 0.6
+
+
+def _views(rgb: np.ndarray) -> List[Tuple[int, int, int, int]]:
+    """(x, y, w, h) regions to run the fixed-input network over: the full
+    frame, a zoomed-OUT 2x canvas (a portrait crop whose face fills the
+    frame lands back in the training scale range), plus four overlapping
+    corner tiles for large frames. Regions may extend beyond the image;
+    extraction pads with mid-gray."""
+    h, w = rgb.shape[:2]
+    views = [(0, 0, w, h), (-w // 2, -h // 2, 2 * w, 2 * h)]
+    if min(h, w) >= MULTISCALE_MIN_SIDE:
+        tw, th = int(w * _TILE_FRAC), int(h * _TILE_FRAC)
+        for ox in (0, w - tw):
+            for oy in (0, h - th):
+                views.append((ox, oy, tw, th))
+    return views
+
+
+def _extract_view(rgb: np.ndarray, x: int, y: int, vw: int, vh: int) -> np.ndarray:
+    """Crop (x, y, vw, vh) with mid-gray padding outside the image."""
+    h, w = rgb.shape[:2]
+    if 0 <= x and 0 <= y and x + vw <= w and y + vh <= h:
+        return rgb[y : y + vh, x : x + vw]
+    canvas = np.full((vh, vw, 3), 128, np.uint8)
+    sx0, sy0 = max(x, 0), max(y, 0)
+    sx1, sy1 = min(x + vw, w), min(y + vh, h)
+    if sx1 > sx0 and sy1 > sy0:
+        canvas[sy0 - y : sy1 - y, sx0 - x : sx1 - x] = rgb[sy0:sy1, sx0:sx1]
+    return canvas
 
 
 def detect_faces(
@@ -205,28 +251,68 @@ def detect_faces_batch(
     score_threshold: float = 0.5,
     max_faces: int = 16,
 ) -> List[List[Tuple[int, int, int, int]]]:
-    """Many images -> boxes in ONE batched forward: the fixed 128x128
-    network input means every request shares a single compiled program
-    (batch axis rides the power-of-two ladder)."""
+    """Many images -> boxes in ONE batched forward: every view of every
+    image shares the fixed 128x128 network input, so the whole multiscale
+    pyramid across all images is a single compiled program launch (batch
+    axis rides the power-of-two ladder). Per image, view detections merge
+    in one global NMS (anchors from a corner tile compete with full-frame
+    anchors on score)."""
     from flyimg_tpu.ops.compose import bucket_batch
 
     n = len(rgbs)
     if n == 0:
         return []
-    nb = bucket_batch(n)
-    inputs = np.zeros((nb, INPUT_SIZE, INPUT_SIZE, 3), np.float32)
-    for i, rgb in enumerate(rgbs):
-        inputs[i] = _network_input(rgb)
-    probs, boxes = _forward(params, jnp.asarray(inputs))
-    probs = np.asarray(probs)
-    boxes = np.asarray(boxes)
-    return [
-        _boxes_from_scores(
-            probs[i], boxes[i], rgbs[i].shape[1], rgbs[i].shape[0],
-            score_threshold, max_faces,
+    views_per = [_views(rgb) for rgb in rgbs]
+    flat: List[np.ndarray] = []
+    for rgb, views in zip(rgbs, views_per):
+        for x, y, vw, vh in views:
+            flat.append(_network_input(_extract_view(rgb, x, y, vw, vh)))
+    # chunk to the runtime's batch-bucket ceiling (runtime/batcher.py
+    # MAX_BATCH_BUCKET): a 64-image aux flush can carry up to 6 views
+    # each, and one 512-wide forward would mean fresh XLA compiles for
+    # never-before-seen buckets at serve time, under burst load
+    from flyimg_tpu.runtime.batcher import MAX_BATCH_BUCKET
+
+    probs_parts, boxes_parts = [], []
+    for start in range(0, len(flat), MAX_BATCH_BUCKET):
+        chunk = flat[start : start + MAX_BATCH_BUCKET]
+        nb = min(bucket_batch(len(chunk)), MAX_BATCH_BUCKET)
+        inputs = np.zeros((nb, INPUT_SIZE, INPUT_SIZE, 3), np.float32)
+        inputs[: len(chunk)] = np.stack(chunk)
+        p, b = _forward(params, jnp.asarray(inputs))
+        probs_parts.append(np.asarray(p)[: len(chunk)])
+        boxes_parts.append(np.asarray(b)[: len(chunk)])
+    probs = np.concatenate(probs_parts)
+    boxes = np.concatenate(boxes_parts)
+
+    out: List[List[Tuple[int, int, int, int]]] = []
+    vi = 0
+    for rgb, views in zip(rgbs, views_per):
+        h, w = rgb.shape[:2]
+        ps, bs = [], []
+        for x, y, vw, vh in views:
+            p = probs[vi]
+            b = boxes[vi]
+            vi += 1
+            # view-normalized (cx, cy, w, h) -> full-frame normalized
+            gb = np.stack(
+                [
+                    (x + b[:, 0] * vw) / w,
+                    (y + b[:, 1] * vh) / h,
+                    b[:, 2] * vw / w,
+                    b[:, 3] * vh / h,
+                ],
+                axis=-1,
+            )
+            ps.append(p)
+            bs.append(gb)
+        out.append(
+            _boxes_from_scores(
+                np.concatenate(ps), np.concatenate(bs), w, h,
+                score_threshold, max_faces,
+            )
         )
-        for i in range(n)
-    ]
+    return out
 
 
 def _iou(a, b) -> float:

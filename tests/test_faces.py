@@ -148,14 +148,43 @@ def test_haar_backend_through_handler(tmp_path):
 @needs_ref_photos
 def test_blazeface_checkpoint_finds_real_face():
     """The packaged BlazeFace checkpoint must localize a real
-    photographed face: its top box on the cropped-portrait fixture
-    overlaps the Haar box."""
-    backend = BlazeFaceBackend(PACKAGED_BLAZEFACE, score_threshold=0.3)
+    photographed face at the DEFAULT serving threshold: exactly one box
+    on the cropped-portrait fixture, solidly overlapping the Haar box
+    (the zoom-out pyramid view puts a full-frame face back in the
+    training scale range)."""
+    backend = BlazeFaceBackend(PACKAGED_BLAZEFACE)
     img = _load("face_cp0.jpg")
     haar_boxes = haar.detect_faces(img)
     bf_boxes = backend.detect_faces(img)
-    assert bf_boxes, "no face detected by blazeface"
-    assert max(_iou(b, haar_boxes[0]) for b in bf_boxes[:3]) >= 0.3
+    assert len(bf_boxes) == 1, bf_boxes
+    assert _iou(bf_boxes[0], haar_boxes[0]) >= 0.5
+
+
+@pytest.mark.skipif(
+    not os.path.exists(PACKAGED_BLAZEFACE),
+    reason="packaged blazeface checkpoint not trained yet",
+)
+@needs_cascade
+@needs_ref_photos
+def test_blazeface_matches_haar_on_group_photo():
+    """Haar-parity gate (the reference FaceDetectProcessorTest photos):
+    the packaged checkpoint, with multiscale inference, must recover the
+    group photo's four Haar faces — each Haar box matched by some
+    BlazeFace box at IoU >= 0.35, and no more than one spurious box.
+    This is the accuracy bar for blazeface as the TPU-serving detector
+    (distilled from Haar by tools/train_blazeface.py: composited-face
+    batches labeled by paste geometry + hard-negative mining rounds)."""
+    backend = BlazeFaceBackend(PACKAGED_BLAZEFACE)
+    img = _load("faces.jpg")
+    haar_boxes = haar.detect_faces(img)
+    assert len(haar_boxes) == 4
+    bf_boxes = backend.detect_faces(img)
+    matched = sum(
+        1 for hb in haar_boxes
+        if any(_iou(bb, hb) >= 0.35 for bb in bf_boxes)
+    )
+    assert matched == 4, (haar_boxes, bf_boxes)
+    assert len(bf_boxes) <= len(haar_boxes) + 1, bf_boxes
 
 
 def test_auto_without_detectors_noops_face_ops(monkeypatch):

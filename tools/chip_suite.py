@@ -111,14 +111,22 @@ def main() -> int:
             1200, results,
         )
         flush()
-    if "bulk" not in args.skip and os.path.isdir(args.bulk_src):
-        run_stage(
-            "e2e_bulk",
-            [py, "-m", "flyimg_tpu.bulk", "--src", args.bulk_src,
-             "--out", "var/tmp/bulk_out_r4", "--options",
-             "w_300,h_250,c_1,smc_1", "--format", "jpg", "--workers", "16"],
-            1800, results,
-        )
+    if "bulk" not in args.skip:
+        if os.path.isdir(args.bulk_src):
+            run_stage(
+                "e2e_bulk",
+                [py, "-m", "flyimg_tpu.bulk", "--src", args.bulk_src,
+                 "--out", "var/tmp/bulk_out_r4", "--options",
+                 "w_300,h_250,c_1,smc_1", "--format", "jpg", "--workers", "16"],
+                1800, results,
+            )
+        else:
+            # record the skip: absent evidence must read as "failed here",
+            # not as if the stage was never part of the ask
+            results.append({
+                "stage": "e2e_bulk", "rc": -2,
+                "error": f"bulk source dir missing: {args.bulk_src}",
+            })
         flush()
     if "http" not in args.skip:
         run_stage(

@@ -71,7 +71,15 @@ def harvest_faces(photo_dirs):
     return faces, backgrounds
 
 
-def _canvas(rng, backgrounds, size):
+def _canvas(rng, backgrounds, size, hard_negatives=None):
+    # hard negatives first: regions the CURRENT model scores as faces but
+    # the Haar oracle rejects — pasting them as face-free canvases is the
+    # classic bootstrapping step that kills crowd/body false positives
+    if hard_negatives and rng.random() < 0.35:
+        from PIL import Image
+
+        crop = hard_negatives[rng.integers(0, len(hard_negatives))]
+        return np.asarray(Image.fromarray(crop).resize((size, size)))
     kind = rng.integers(0, 3 if backgrounds else 2)
     if kind == 0:
         return rng.integers(0, 256, (size, size, 3)).astype(np.uint8)
@@ -91,7 +99,37 @@ def _canvas(rng, backgrounds, size):
     )
 
 
-def real_batch(rng, batch, faces, backgrounds):
+def mine_hard_negatives(params, backgrounds, *, score_threshold=0.4):
+    """Regions the model detects (with margin) that no Haar box overlaps:
+    false-positive material for the next training round."""
+    from flyimg_tpu.models import blazeface as bf
+    from flyimg_tpu.models import haar
+
+    def overlaps(a, b):
+        ax, ay, aw, ah = a
+        bx, by, bw, bh = b
+        return (
+            min(ax + aw, bx + bw) > max(ax, bx)
+            and min(ay + ah, by + bh) > max(ay, by)
+        )
+
+    negatives = []
+    for img in backgrounds:
+        truth = haar.detect_faces(img)
+        for box in bf.detect_faces(params, img, score_threshold=score_threshold):
+            if any(overlaps(box, t) for t in truth):
+                continue
+            x, y, w, h = box
+            m = int(0.3 * max(w, h))
+            y0, y1 = max(y - m, 0), min(y + h + m, img.shape[0])
+            x0, x1 = max(x - m, 0), min(x + w + m, img.shape[1])
+            crop = img[y0:y1, x0:x1]
+            if min(crop.shape[:2]) >= 24:
+                negatives.append(np.ascontiguousarray(crop))
+    return negatives
+
+
+def real_batch(rng, batch, faces, backgrounds, hard_negatives=None):
     """Augmented real-face batch with the same anchor-target scheme as
     blazeface.synthetic_batch."""
     from PIL import Image
@@ -105,7 +143,9 @@ def real_batch(rng, batch, faces, backgrounds):
     target_boxes = np.zeros((batch, bf.NUM_ANCHORS, 4), np.float32)
     mask = np.zeros((batch, bf.NUM_ANCHORS), np.float32)
     for i in range(batch):
-        canvas = _canvas(rng, backgrounds, size).astype(np.float32)
+        canvas = _canvas(rng, backgrounds, size, hard_negatives).astype(
+            np.float32
+        )
         n_faces = rng.integers(0, 3)  # 0..2 faces (negatives matter)
         for _ in range(n_faces):
             crop, (fx, fy, fw, fh) = faces[rng.integers(0, len(faces))]
@@ -151,6 +191,46 @@ def real_batch(rng, batch, faces, backgrounds):
     return images, target_probs, target_boxes, mask
 
 
+def evaluate(checkpoint: str) -> int:
+    """Print the Haar-parity metrics (the tests/test_faces.py gate) for a
+    checkpoint: per-photo IoU of BlazeFace boxes against the Haar oracle
+    on the reference fixtures."""
+    import numpy as np
+    from PIL import Image
+
+    from flyimg_tpu.models import blazeface as bf
+    from flyimg_tpu.models import haar
+
+    def iou(a, b):
+        ax, ay, aw, ah = a
+        bx, by, bw, bh = b
+        ix = max(0, min(ax + aw, bx + bw) - max(ax, bx))
+        iy = max(0, min(ay + ah, by + bh) - max(ay, by))
+        inter = ix * iy
+        union = aw * ah + bw * bh - inter
+        return inter / union if union else 0.0
+
+    params = bf.load_checkpoint(checkpoint)
+    rc = 0
+    for name in ("faces.jpg", "face_cp0.jpg", "face_cp1.jpg"):
+        path = os.path.join("/root/reference/tests/testImages", name)
+        if not os.path.exists(path):
+            continue
+        img = np.asarray(Image.open(path).convert("RGB"))
+        hb = haar.detect_faces(img)
+        bb = bf.detect_faces(params, img, score_threshold=0.3)
+        matches = [max((iou(b, h) for b in bb), default=0.0) for h in hb]
+        ok = hb and all(m >= 0.35 for m in matches)
+        if hb and not ok:
+            rc = 1
+        print(
+            f"{name}: haar={len(hb)} blazeface={len(bb)} "
+            f"ious={[round(m, 2) for m in matches]} "
+            f"{'OK' if ok else 'MISS'}"
+        )
+    return rc
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=800)
@@ -167,12 +247,28 @@ def main() -> int:
         help="force a jax platform (e.g. 'cpu' — needed in environments "
              "whose sitecustomize pins a TPU backend)",
     )
+    ap.add_argument(
+        "--eval", metavar="CKPT", default=None,
+        help="skip training; print Haar-parity metrics for a checkpoint",
+    )
+    ap.add_argument(
+        "--init", metavar="CKPT", default=None,
+        help="resume/fine-tune from a checkpoint instead of fresh params",
+    )
+    ap.add_argument(
+        "--mine-hard-negatives", action="store_true",
+        help="with --init: run the init model over the photo set first and "
+             "train against its false positives (bootstrapping round)",
+    )
     args = ap.parse_args()
 
     if args.platform == "cpu":
         from flyimg_tpu.parallel.mesh import force_cpu_platform
 
         force_cpu_platform(1)
+
+    if args.eval:
+        return evaluate(args.eval)
 
     import jax
     import jax.numpy as jnp
@@ -184,7 +280,15 @@ def main() -> int:
     print(f"harvested {len(faces)} real face crops, "
           f"{len(backgrounds)} background photos")
 
-    params = bf.init_params(jax.random.PRNGKey(args.seed))
+    if args.init:
+        params = bf.load_checkpoint(args.init)
+        print(f"resuming from {args.init}")
+    else:
+        params = bf.init_params(jax.random.PRNGKey(args.seed))
+    hard_negatives = []
+    if args.mine_hard_negatives and args.init:
+        hard_negatives = mine_hard_negatives(params, backgrounds)
+        print(f"mined {len(hard_negatives)} hard-negative regions")
     optimizer, train_step = bf.make_train_step()
     opt_state = optimizer.init(params)
     step_fn = jax.jit(train_step, donate_argnums=(0, 1))
@@ -192,7 +296,9 @@ def main() -> int:
     for step in range(args.steps):
         use_real = faces and rng.random() < args.real_fraction
         if use_real:
-            batch = real_batch(rng, args.batch, faces, backgrounds)
+            batch = real_batch(
+                rng, args.batch, faces, backgrounds, hard_negatives
+            )
         else:
             batch = bf.synthetic_batch(rng, args.batch)
         params, opt_state, loss = step_fn(
