@@ -217,17 +217,33 @@ def _views(rgb: np.ndarray) -> List[Tuple[int, int, int, int]]:
     return views
 
 
-def _extract_view(rgb: np.ndarray, x: int, y: int, vw: int, vh: int) -> np.ndarray:
-    """Crop (x, y, vw, vh) with mid-gray padding outside the image."""
+def _view_input(rgb: np.ndarray, x: int, y: int, vw: int, vh: int) -> np.ndarray:
+    """Network input for view (x, y, vw, vh), which may extend beyond the
+    image (mid-gray outside). The padded case resizes the visible part
+    DIRECTLY to its slot in the 128x128 canvas — materializing the view
+    at source resolution first (e.g. a 2w x 2h zoom-out canvas of a large
+    upload) would allocate 4x the image per request just to throw it away
+    in the downscale."""
+    from PIL import Image
+
     h, w = rgb.shape[:2]
     if 0 <= x and 0 <= y and x + vw <= w and y + vh <= h:
-        return rgb[y : y + vh, x : x + vw]
-    canvas = np.full((vh, vw, 3), 128, np.uint8)
+        return _network_input(rgb[y : y + vh, x : x + vw])
+    canvas = np.full((INPUT_SIZE, INPUT_SIZE, 3), 128, np.uint8)
     sx0, sy0 = max(x, 0), max(y, 0)
     sx1, sy1 = min(x + vw, w), min(y + vh, h)
     if sx1 > sx0 and sy1 > sy0:
-        canvas[sy0 - y : sy1 - y, sx0 - x : sx1 - x] = rgb[sy0:sy1, sx0:sx1]
-    return canvas
+        dx0 = round((sx0 - x) * INPUT_SIZE / vw)
+        dx1 = round((sx1 - x) * INPUT_SIZE / vw)
+        dy0 = round((sy0 - y) * INPUT_SIZE / vh)
+        dy1 = round((sy1 - y) * INPUT_SIZE / vh)
+        if dx1 > dx0 and dy1 > dy0:
+            canvas[dy0:dy1, dx0:dx1] = np.asarray(
+                Image.fromarray(rgb[sy0:sy1, sx0:sx1]).resize(
+                    (dx1 - dx0, dy1 - dy0), Image.BILINEAR
+                )
+            )
+    return canvas.astype(np.float32) / 127.5 - 1.0
 
 
 def detect_faces(
@@ -257,8 +273,6 @@ def detect_faces_batch(
     axis rides the power-of-two ladder). Per image, view detections merge
     in one global NMS (anchors from a corner tile compete with full-frame
     anchors on score)."""
-    from flyimg_tpu.ops.compose import bucket_batch
-
     n = len(rgbs)
     if n == 0:
         return []
@@ -266,17 +280,17 @@ def detect_faces_batch(
     flat: List[np.ndarray] = []
     for rgb, views in zip(rgbs, views_per):
         for x, y, vw, vh in views:
-            flat.append(_network_input(_extract_view(rgb, x, y, vw, vh)))
+            flat.append(_view_input(rgb, x, y, vw, vh))
     # chunk to the runtime's batch-bucket ceiling (runtime/batcher.py
     # MAX_BATCH_BUCKET): a 64-image aux flush can carry up to 6 views
     # each, and one 512-wide forward would mean fresh XLA compiles for
     # never-before-seen buckets at serve time, under burst load
-    from flyimg_tpu.runtime.batcher import MAX_BATCH_BUCKET
+    from flyimg_tpu.runtime.batcher import MAX_BATCH_BUCKET, _round_batch
 
     probs_parts, boxes_parts = [], []
     for start in range(0, len(flat), MAX_BATCH_BUCKET):
         chunk = flat[start : start + MAX_BATCH_BUCKET]
-        nb = min(bucket_batch(len(chunk)), MAX_BATCH_BUCKET)
+        nb = _round_batch(len(chunk))
         inputs = np.zeros((nb, INPUT_SIZE, INPUT_SIZE, 3), np.float32)
         inputs[: len(chunk)] = np.stack(chunk)
         p, b = _forward(params, jnp.asarray(inputs))

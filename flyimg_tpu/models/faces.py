@@ -66,7 +66,7 @@ class BlazeFaceBackend:
     """BlazeFace convnet detection; fixed 128x128 input makes batched
     serving trivial (one jitted program, period)."""
 
-    def __init__(self, checkpoint: str, *, score_threshold: float = 0.6) -> None:
+    def __init__(self, checkpoint: str, *, score_threshold: float = 0.8) -> None:
         from flyimg_tpu.models import blazeface
 
         self._bf = blazeface

@@ -212,10 +212,12 @@ def evaluate(checkpoint: str) -> int:
 
     params = bf.load_checkpoint(checkpoint)
     rc = 0
+    evaluated = 0
     for name in ("faces.jpg", "face_cp0.jpg", "face_cp1.jpg"):
-        path = os.path.join("/root/reference/tests/testImages", name)
+        path = os.path.join(DEFAULT_PHOTO_DIRS[0], name)
         if not os.path.exists(path):
             continue
+        evaluated += 1
         img = np.asarray(Image.open(path).convert("RGB"))
         hb = haar.detect_faces(img)
         bb = bf.detect_faces(params, img, score_threshold=0.3)
@@ -228,6 +230,11 @@ def evaluate(checkpoint: str) -> int:
             f"ious={[round(m, 2) for m in matches]} "
             f"{'OK' if ok else 'MISS'}"
         )
+    if evaluated == 0:
+        # a missing fixture dir must not read as a PASSING parity gate
+        print(f"no eval fixtures found under {DEFAULT_PHOTO_DIRS[0]}",
+              file=sys.stderr)
+        return 2
     return rc
 
 
@@ -269,6 +276,11 @@ def main() -> int:
 
     if args.eval:
         return evaluate(args.eval)
+
+    if args.mine_hard_negatives and not args.init:
+        ap.error("--mine-hard-negatives requires --init (mining runs the "
+                 "INIT model over the photo set; fresh params would mine "
+                 "noise)")
 
     import jax
     import jax.numpy as jnp
