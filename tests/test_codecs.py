@@ -600,3 +600,55 @@ def test_st0_metadata_carry_webp(tmp_path):
         assert out.size == (100, 156) or out.size[0] < out.size[1], (
             src, out_fmt, out.size,
         )
+
+
+def test_metadata_parsers_survive_fuzzed_bytes():
+    """The container parsers eat attacker-controlled bytes on every
+    request; none of them may raise on garbage — malformed input means
+    'no metadata', never a 500. Seeded structured fuzz: random bytes,
+    truncations of valid files, and bit-flipped valid files."""
+    from flyimg_tpu.codecs import metadata as m
+    from flyimg_tpu.codecs.exif import jpeg_orientation, tiff_orientation
+
+    rng = np.random.default_rng(99)
+    icc = _icc_profile_bytes()
+    base_jpg = encode(_img(seed=40), "jpg")
+    base_png = encode(_img(seed=41), "png")
+    base_webp = encode(_img(seed=42), "webp")
+
+    meta = m.SourceMetadata(icc=icc, exif_tiff=b"II*\x00" + bytes(64))
+    corpora = []
+    for _ in range(60):
+        corpora.append(rng.integers(0, 256, rng.integers(0, 400)).astype(
+            np.uint8).tobytes())
+    for base in (base_jpg, base_png, base_webp):
+        for _ in range(40):
+            cut = int(rng.integers(0, len(base)))
+            corpora.append(base[:cut])
+            flipped = bytearray(base)
+            for _ in range(4):
+                flipped[int(rng.integers(0, len(base)))] ^= int(
+                    rng.integers(1, 256)
+                )
+            corpora.append(bytes(flipped))
+    # adversarial prefixes that look like each container
+    corpora += [
+        b"\xff\xd8\xff\xe1\xff\xff",            # APP1 with huge length
+        b"\x89PNG\r\n\x1a\n" + b"\xff" * 20,    # bad chunk length
+        b"RIFF\xff\xff\xff\xffWEBP" + b"\x00" * 8,
+    ]
+    for blob in corpora:
+        for mime in ("image/jpeg", "image/png", "image/webp"):
+            got = m.collect(blob, mime)
+            # inject into valid outputs must also never raise
+            m.inject(base_jpg, "jpg", got)
+            m.inject(base_png, "png", got)
+            m.inject(base_webp, "webp", got)
+        # and injecting VALID metadata into the fuzzed blob can't raise
+        m.inject(blob, "jpg", meta)
+        m.inject(blob, "png", meta)
+        m.inject(blob, "webp", meta)
+        assert 1 <= jpeg_orientation(blob) <= 8
+        assert 1 <= tiff_orientation(blob) <= 8
+        assert 1 <= m.png_orientation(blob) <= 8
+        assert 1 <= m.webp_orientation(blob) <= 8
