@@ -3,8 +3,7 @@
 The BASELINE.json headline workload ("images/sec/chip (resize+smart-crop)"):
 batches of 512x512 uint8 images through the fused device program — windowed
 crop-fill resample to 300x250 (MXU einsums, bf16 multiplies), the
-smart-crop saliency field (Pallas stencil kernel on TPU), and the
-candidate-scoring conv — measured at steady state, inputs device-resident.
+smart-crop saliency field, and the candidate-scoring conv — measured at steady state, inputs device-resident.
 
 Measurement model: K batches per device launch via ``lax.scan`` (one
 dispatch, K sequential batch programs), median over several launches. This

@@ -150,9 +150,8 @@ def score_grid(
 def score_grid_from_weighted(
     weighted: jnp.ndarray, crop_w: float, crop_h: float, stride: int = 8
 ) -> jnp.ndarray:
-    """Candidate scores given a precomputed weighted field (either
-    ``weighted_field(analyse_features(...))`` or the fused Pallas kernel
-    ``ops.pallas_kernels.saliency_field``)."""
+    """Candidate scores given a precomputed weighted field
+    (``weighted_field(analyse_features(...))``)."""
     kernel = jnp.asarray(importance_kernel(crop_w, crop_h))
     kh, kw = kernel.shape
     inside = _conv_scores(weighted, kernel, stride)
@@ -176,7 +175,6 @@ def find_best_crop(
     max_scale: float = 1.0,
     scale_step: float = 0.1,
     step: int = 8,
-    use_pallas: bool | None = None,
 ) -> Dict[str, int]:
     """Best crop of [h, w, 3] uint8 -> dict(x, y, width, height), in source
     pixel coords. Mirrors SmartCrop.crop() including prescale bookkeeping
@@ -187,17 +185,12 @@ def find_best_crop(
     )
 
     # the weighted scoring field, computed ONCE and reused across scales.
-    # The XLA feature-map path is canonical: measured on-chip it matches
-    # the fused Pallas stencil kernel's speed (XLA fuses this elementwise+
-    # small-stencil chain itself), and it is bit-identical to the batched
-    # serving path, where the Pallas field differs by up to ~7e-3 (enough
-    # to flip an argmax near-tie). Pallas stays as an explicit opt-in.
-    if use_pallas:
-        from flyimg_tpu.ops.pallas_kernels import saliency_field
-
-        weighted = saliency_field(jnp.asarray(item.work))
-    else:
-        weighted = weighted_field(analyse_features(jnp.asarray(item.work)))
+    # XLA fuses this elementwise + small-stencil chain itself: a
+    # hand-written fused-VMEM Pallas kernel for it was measured on-chip in
+    # round 3 at the SAME speed as this path while diverging numerically
+    # by up to ~7e-3 (enough to flip an argmax near-tie), so it was
+    # removed — don't hand-schedule what the compiler already fuses.
+    weighted = weighted_field(analyse_features(jnp.asarray(item.work)))
 
     best = None
     for s in item.scales:

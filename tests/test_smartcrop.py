@@ -157,7 +157,7 @@ def test_batched_crops_match_single_path():
         img[hh // 4 : hh // 2, ww // 4 : ww // 2] = (220, 160, 130)
 
     batched = find_best_crops_batched([prepare_work(img) for img in images])
-    singles = [find_best_crop(img, 100, 100, use_pallas=False) for img in images]
+    singles = [find_best_crop(img, 100, 100) for img in images]
     assert batched == singles
 
 
@@ -174,5 +174,5 @@ def test_batched_mixed_buckets_and_small_images():
         for h, w in [(80, 120), (500, 150), (120, 120)]
     ]
     batched = find_best_crops_batched([prepare_work(img) for img in images])
-    singles = [find_best_crop(img, 100, 100, use_pallas=False) for img in images]
+    singles = [find_best_crop(img, 100, 100) for img in images]
     assert batched == singles

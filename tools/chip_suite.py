@@ -1,8 +1,8 @@
 """One-shot on-chip evidence capture for a (possibly brief) tunnel window.
 
 The round-3 verdict's top asks are all TPU artifacts: a green BENCH, an
-end-to-end bulk number including decode+encode, p99 under load, a Pallas
-vs XLA decision, and the stage profile explaining the r2->r3 ~4% delta.
+end-to-end bulk number including decode+encode, p99 under load, and the
+stage profile explaining the r2->r3 ~4% delta.
 The tunnel in this environment goes down for hours at a stretch, so when
 it IS up, everything must be captured in one command:
 
@@ -82,7 +82,7 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="benchmarks/chip_suite_r4.json")
     ap.add_argument("--skip", action="append", default=[],
-                    choices=["bench", "ops", "bulk", "http", "pallas"])
+                    choices=["bench", "ops", "bulk", "http"])
     ap.add_argument("--bulk-src", default="var/bench_images")
     args = ap.parse_args()
 
@@ -136,14 +136,6 @@ def main() -> int:
             1800, results,
         )
         flush()
-    if "pallas" not in args.skip:
-        run_stage(
-            "pallas_vs_xla",
-            [py, "benchmarks/bench_pallas.py"],
-            900, results,
-        )
-        flush()
-
     flush()
     print(json.dumps({"stages": [
         {k: e.get(k) for k in ("stage", "rc", "seconds")} for e in results
