@@ -1,10 +1,14 @@
-"""PDF rasterization (gated ingestion backend).
+"""PDF rasterization: ghostscript when present, mini-rasterizer fallback.
 
 Reference behavior: PDFs are rasterized by ImageMagick's ghostscript
 delegate with ``-density`` and a ``[page-1]`` selector (reference
 src/Core/Processor/ImageProcessor.php:70-72,80-84; Dockerfile:5 installs
-ghostscript). This image has no ghostscript, so the backend is gated the
-same way as video: present -> rasterize; absent -> UnsupportedMediaException.
+ghostscript). Where gs exists (the shipped Docker image) it handles full
+PDF. Where it does not (this dev runtime), ``pdf_mini`` renders the
+image-only subset from scratch — scanned/PIL/img2pdf-style documents —
+and refuses anything needing a font engine or path rasterizer, so the
+path is demonstrable everywhere without ever producing approximate
+output for documents it cannot honor.
 """
 
 from __future__ import annotations
@@ -12,26 +16,27 @@ from __future__ import annotations
 import shutil
 import subprocess
 
-from flyimg_tpu.exceptions import ExecFailedException, UnsupportedMediaException
+from flyimg_tpu.exceptions import ExecFailedException, InvalidArgumentException
 
 GHOSTSCRIPT = shutil.which("gs")
 DEFAULT_DENSITY = 96  # IM's default PDF density is 72; flyimg exposes dnst_
-
-
-def ghostscript_available() -> bool:
-    return GHOSTSCRIPT is not None
+MAX_DENSITY = 9600    # 100x the default; past this the raster ceiling always trips
 
 
 def rasterize_page(
     pdf_path: str, out_path: str, page: int = 1, density: int | None = None
 ) -> str:
     """Rasterize one 1-indexed page to PNG at ``density`` dpi."""
-    if GHOSTSCRIPT is None:
-        raise UnsupportedMediaException(
-            "pdf sources need ghostscript, which is not available in this runtime"
-        )
     dpi = int(density or DEFAULT_DENSITY)
+    if not 0 < dpi <= MAX_DENSITY:
+        # validated here so BOTH backends agree: gs would fail with a
+        # cryptic rc on -r-96, the mini path would emit a 1x1 blank
+        raise InvalidArgumentException(f"dnst_{dpi} out of range (1..{MAX_DENSITY})")
     page = max(int(page), 1)
+    if GHOSTSCRIPT is None:
+        from flyimg_tpu.codecs.pdf_mini import rasterize_page_mini
+
+        return rasterize_page_mini(pdf_path, out_path, page, dpi)
     cmd = [
         GHOSTSCRIPT, "-dSAFER", "-dBATCH", "-dNOPAUSE", "-sDEVICE=png16m",
         f"-r{dpi}", f"-dFirstPage={page}", f"-dLastPage={page}",

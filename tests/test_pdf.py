@@ -2,9 +2,10 @@
 ghostscript delegate with -density and a [page-1] selector
 (src/Core/Processor/ImageProcessor.php:70-84; its Dockerfile installs
 ghostscript). These tests generate a 2-page PDF with PIL (no binary
-fixtures) and drive the full handler pipeline; rasterization tests skip
-where gs is absent (this dev image), and CI + the shipped container run
-them for real."""
+fixtures) and drive the full handler pipeline. Where gs is absent (this
+dev image) the codecs.pdf dispatch falls back to the from-scratch
+image-only mini rasterizer, so the whole path runs everywhere; the
+shipped container exercises the ghostscript branch of the same tests."""
 
 import io
 
@@ -16,10 +17,6 @@ from flyimg_tpu.appconfig import AppParameters
 from flyimg_tpu.codecs import pdf as pdf_codec
 from flyimg_tpu.service.handler import ImageHandler
 from flyimg_tpu.storage import make_storage
-
-needs_gs = pytest.mark.skipif(
-    not pdf_codec.ghostscript_available(), reason="ghostscript not installed"
-)
 
 
 @pytest.fixture()
@@ -42,7 +39,6 @@ def _write_pdf(path) -> str:
     return str(path)
 
 
-@needs_gs
 def test_pdf_page_select(env):
     handler, tmp = env
     src = _write_pdf(tmp / "doc.pdf")
@@ -57,7 +53,6 @@ def test_pdf_page_select(env):
     assert out1.spec.name != out2.spec.name
 
 
-@needs_gs
 def test_pdf_density_scales_raster(env):
     handler, tmp = env
     src = _write_pdf(tmp / "doc.pdf")
@@ -65,13 +60,12 @@ def test_pdf_density_scales_raster(env):
     hi = handler.process_image("dnst_192,o_png", src)
     lo_img = Image.open(io.BytesIO(lo.content))
     hi_img = Image.open(io.BytesIO(hi.content))
-    # 192 dpi raster is ~2x the default 96 dpi one (gs rounds fractional
-    # point sizes per-dpi, so allow a couple of pixels of slack)
+    # 192 dpi raster is ~2x the default 96 dpi one (rasterizers round
+    # fractional point sizes per-dpi, so allow a couple of pixels of slack)
     assert abs(hi_img.width - 2 * lo_img.width) <= 2
     assert abs(hi_img.height - 2 * lo_img.height) <= 2
 
 
-@needs_gs
 def test_pdf_page_past_end_fails(env):
     from flyimg_tpu.exceptions import ExecFailedException
 
@@ -81,7 +75,6 @@ def test_pdf_page_past_end_fails(env):
         handler.process_image("pg_9,o_png", src)
 
 
-@needs_gs
 def test_pdf_then_transform_pipeline(env):
     handler, tmp = env
     src = _write_pdf(tmp / "doc.pdf")
@@ -91,12 +84,30 @@ def test_pdf_then_transform_pipeline(env):
     assert img.size == (120, 60)
 
 
-def test_pdf_gated_when_gs_absent(env, monkeypatch):
-    """Without ghostscript the PDF path must 415 explicitly, not crash."""
+TEXT_PDF = b"""%PDF-1.4
+1 0 obj<< /Type /Catalog /Pages 2 0 R >>endobj
+2 0 obj<< /Type /Pages /Count 1 /Kids [3 0 R] >>endobj
+3 0 obj<< /Type /Page /Parent 2 0 R /MediaBox [0 0 200 100]
+  /Resources << /Font << /F1 5 0 R >> >> /Contents 4 0 R >>endobj
+4 0 obj<< /Length 44 >>stream
+BT /F1 12 Tf 20 50 Td (Hello world) Tj ET
+endstream
+endobj
+5 0 obj<< /Type /Font /Subtype /Type1 /BaseFont /Helvetica >>endobj
+trailer<< /Root 1 0 R >>
+%%EOF
+"""
+
+
+def test_pdf_text_refused_without_gs(env, monkeypatch, tmp_path):
+    """The mini rasterizer must refuse documents it cannot honor exactly
+    (text needs a font engine) rather than render a blank page — the
+    reference's gs renders it; ours 415s when gs is absent."""
     from flyimg_tpu.exceptions import UnsupportedMediaException
 
     handler, tmp = env
-    src = _write_pdf(tmp / "doc.pdf")
+    src = tmp_path / "text.pdf"
+    src.write_bytes(TEXT_PDF)
     monkeypatch.setattr(pdf_codec, "GHOSTSCRIPT", None)
     with pytest.raises(UnsupportedMediaException):
-        handler.process_image("pg_1,o_png", src)
+        handler.process_image("pg_1,o_png", str(src))

@@ -1,0 +1,364 @@
+"""Unit tests for the from-scratch image-only PDF rasterizer
+(flyimg_tpu/codecs/pdf_mini.py). Documents are hand-assembled byte-wise so
+every structural feature under test (filters, SMask, Rotate, CTM flips,
+refusal classes) is explicit — no generator library in the loop."""
+
+import zlib
+
+import numpy as np
+import pytest
+
+from flyimg_tpu.codecs.pdf_mini import MiniPdf, PdfRefusal
+from flyimg_tpu.exceptions import ExecFailedException
+
+
+def _pdf(objects: dict[int, bytes], root: int = 1) -> bytes:
+    out = [b"%PDF-1.4\n"]
+    for num, body in objects.items():
+        out.append(b"%d 0 obj" % num + body + b"endobj\n")
+    out.append(b"trailer<< /Root %d 0 R >>\n%%%%EOF\n" % root)
+    return b"".join(out)
+
+
+def _stream(d: bytes, extra: bytes = b"") -> bytes:
+    return (
+        b"<< /Length %d %s>>stream\n" % (len(d), extra) + d + b"\nendstream\n"
+    )
+
+
+def _flate_image(px: np.ndarray, colorspace: bytes = b"/DeviceRGB",
+                 extra: bytes = b"") -> bytes:
+    data = zlib.compress(px.tobytes())
+    h, w = px.shape[:2]
+    head = (
+        b"/Type /XObject /Subtype /Image /Width %d /Height %d "
+        b"/Filter /FlateDecode /BitsPerComponent 8 /ColorSpace %s %s"
+        % (w, h, colorspace, extra)
+    )
+    return _stream(data, head)
+
+
+def _page_objs(content: bytes, media=b"[0 0 20 10]",
+               xobj=b"<< /im 4 0 R >>", page_extra=b""):
+    return {
+        1: b"<< /Type /Catalog /Pages 2 0 R >>",
+        2: b"<< /Type /Pages /Count 1 /Kids [3 0 R] >>",
+        3: (
+            b"<< /Type /Page /Parent 2 0 R /MediaBox " + media
+            + b" /Resources << /XObject " + xobj + b" >> /Contents 5 0 R "
+            + page_extra + b">>"
+        ),
+        5: _stream(content),
+    }
+
+
+def _solid(w, h, rgb):
+    return np.tile(np.array(rgb, np.uint8), (h, w, 1))
+
+
+def test_flate_rgb_image_fills_rect():
+    objs = _page_objs(b"q 20 0 0 10 0 0 cm /im Do Q")
+    objs[4] = _flate_image(_solid(2, 2, (10, 200, 30)))
+    doc = MiniPdf(_pdf(objs))
+    arr = doc.rasterize(1, 72)  # 1pt = 1px
+    assert arr.shape == (10, 20, 3)
+    assert (arr == [10, 200, 30]).all()
+
+
+def test_gray_image_and_partial_rect_on_white():
+    objs = _page_objs(b"q 10 0 0 5 5 0 cm /im Do Q")
+    objs[4] = _flate_image(_solid(2, 2, (40,))[:, :, :1], b"/DeviceGray")
+    arr = MiniPdf(_pdf(objs)).rasterize(1, 72)
+    # left 5 columns untouched white; the placed rect is gray 40
+    assert (arr[:, :5] == 255).all()
+    assert (arr[5:, 5:15] == 40).all()
+
+
+def test_image_row0_lands_at_top_of_rect():
+    # 1x2 image: top sample red, bottom sample blue
+    px = np.array([[[255, 0, 0]], [[0, 0, 255]]], np.uint8)
+    objs = _page_objs(b"q 20 0 0 10 0 0 cm /im Do Q")
+    objs[4] = _flate_image(px)
+    arr = MiniPdf(_pdf(objs)).rasterize(1, 72)
+    assert (arr[0, 0] == [255, 0, 0]).all()      # raster top = image row 0
+    assert (arr[-1, 0] == [0, 0, 255]).all()
+
+
+def test_negative_d_flips_vertically():
+    px = np.array([[[255, 0, 0]], [[0, 0, 255]]], np.uint8)
+    # d < 0 with f at the top edge: image drawn upside down
+    objs = _page_objs(b"q 20 0 0 -10 0 10 cm /im Do Q")
+    objs[4] = _flate_image(px)
+    arr = MiniPdf(_pdf(objs)).rasterize(1, 72)
+    assert (arr[0, 0] == [0, 0, 255]).all()
+    assert (arr[-1, 0] == [255, 0, 0]).all()
+
+
+def test_smask_alpha_blends_over_white():
+    objs = _page_objs(b"q 20 0 0 10 0 0 cm /im Do Q")
+    objs[4] = _flate_image(_solid(2, 2, (0, 0, 0)), b"/DeviceRGB",
+                           b"/SMask 6 0 R ")
+    # uniform alpha 128 -> black over white ~= 127
+    objs[6] = _flate_image(_solid(2, 2, (128,))[:, :, :1], b"/DeviceGray")
+    arr = MiniPdf(_pdf(objs)).rasterize(1, 72)
+    assert abs(int(arr[5, 10, 0]) - 127) <= 1
+
+
+def test_page_rotate_90():
+    objs = _page_objs(b"q 20 0 0 10 0 0 cm /im Do Q",
+                      page_extra=b"/Rotate 90 ")
+    objs[4] = _flate_image(_solid(2, 2, (9, 9, 9)))
+    arr = MiniPdf(_pdf(objs)).rasterize(1, 72)
+    assert arr.shape == (20, 10, 3)  # landscape page displayed portrait
+
+
+def test_mediabox_origin_offset():
+    objs = _page_objs(b"q 20 0 0 10 100 50 cm /im Do Q",
+                      media=b"[100 50 120 60]")
+    objs[4] = _flate_image(_solid(2, 2, (1, 2, 3)))
+    arr = MiniPdf(_pdf(objs)).rasterize(1, 72)
+    assert arr.shape == (10, 20, 3)
+    assert (arr == [1, 2, 3]).all()
+
+
+def test_density_scales_raster():
+    objs = _page_objs(b"q 20 0 0 10 0 0 cm /im Do Q")
+    objs[4] = _flate_image(_solid(2, 2, (5, 5, 5)))
+    doc = MiniPdf(_pdf(objs))
+    assert doc.rasterize(1, 144).shape == (20, 40, 3)
+
+
+def test_page_out_of_range_is_exec_failure():
+    objs = _page_objs(b"")
+    with pytest.raises(ExecFailedException):
+        MiniPdf(_pdf(objs)).rasterize(3, 72)
+
+
+def test_path_paint_refused():
+    objs = _page_objs(b"0 0 10 10 re f")
+    with pytest.raises(PdfRefusal):
+        MiniPdf(_pdf(objs)).rasterize(1, 72)
+
+
+def test_rotated_ctm_refused():
+    objs = _page_objs(b"q 1 1 -1 1 0 0 cm /im Do Q")
+    objs[4] = _flate_image(_solid(2, 2, (0, 0, 0)))
+    with pytest.raises(PdfRefusal):
+        MiniPdf(_pdf(objs)).rasterize(1, 72)
+
+
+def test_objstm_only_document_refused():
+    # no scannable "N 0 obj" bodies at all -> refuse at construction
+    with pytest.raises(PdfRefusal):
+        MiniPdf(b"%PDF-1.5\nstartxref\n0\n%%EOF\n")
+
+
+def test_non_pdf_refused():
+    with pytest.raises(PdfRefusal):
+        MiniPdf(b"GIF89a not a pdf")
+
+
+# -- hardening regressions (code-review findings): malformed/hostile inputs
+# must surface as refusals (-> 415 through the app status map), never 500s,
+# and never unbounded allocations.
+
+
+def test_obj_token_inside_stream_payload_is_skipped():
+    """Binary stream payloads can contain 'N 0 obj' by chance; the scanner
+    must jump over payloads instead of letting garbage overwrite objects."""
+    objs = _page_objs(b"q 20 0 0 10 0 0 cm /im Do Q")
+    # payload poisoned with a fake redefinition of page object 3
+    poison = b"junk 3 0 obj 7 junk"
+    h, w = 2, 2
+    head = (
+        b"/Type /XObject /Subtype /Image /Width %d /Height %d "
+        b"/BitsPerComponent 8 /ColorSpace /DeviceRGB" % (w, h)
+    )
+    payload = _solid(w, h, (1, 2, 3)).tobytes() + poison
+    # declared Length covers only the real pixels; the poison rides inside
+    # the scan span up to endstream in a no-Length sibling object
+    objs[4] = _stream(payload[: w * h * 3], head)
+    objs[9] = _stream(poison, b"/Type /Junk")
+    arr = MiniPdf(_pdf(objs)).rasterize(1, 72)
+    assert (arr == [1, 2, 3]).all()
+
+
+def test_corrupt_flate_stream_is_refusal_not_crash(tmp_path):
+    from flyimg_tpu.codecs.pdf_mini import rasterize_page_mini
+
+    objs = _page_objs(b"q 20 0 0 10 0 0 cm /im Do Q")
+    head = (
+        b"/Type /XObject /Subtype /Image /Width 2 /Height 2 "
+        b"/Filter /FlateDecode /BitsPerComponent 8 /ColorSpace /DeviceRGB"
+    )
+    objs[4] = _stream(b"\xde\xad\xbe\xef not zlib", head)
+    src = tmp_path / "bad.pdf"
+    src.write_bytes(_pdf(objs))
+    with pytest.raises(PdfRefusal):
+        rasterize_page_mini(str(src), str(tmp_path / "out.png"))
+
+
+def test_huge_mediabox_refused_before_allocation():
+    objs = _page_objs(b"", media=b"[0 0 2000000 2000000]")
+    with pytest.raises(PdfRefusal):
+        MiniPdf(_pdf(objs)).rasterize(1, 96)
+
+
+def test_short_mediabox_is_refusal(tmp_path):
+    from flyimg_tpu.codecs.pdf_mini import rasterize_page_mini
+
+    objs = _page_objs(b"", media=b"[0 0]")
+    src = tmp_path / "bad.pdf"
+    src.write_bytes(_pdf(objs))
+    with pytest.raises(PdfRefusal):
+        rasterize_page_mini(str(src), str(tmp_path / "out.png"))
+
+
+def test_self_referencing_smask_refused():
+    objs = _page_objs(b"q 20 0 0 10 0 0 cm /im Do Q")
+    objs[4] = _flate_image(_solid(2, 2, (0, 0, 0)), b"/DeviceRGB",
+                           b"/SMask 4 0 R ")
+    with pytest.raises(PdfRefusal):
+        MiniPdf(_pdf(objs)).rasterize(1, 72)
+
+
+def test_obj_token_inside_literal_string_is_skipped():
+    """'N G obj' inside a parsed object BODY (a literal string) must not
+    clobber the real object N either."""
+    objs = _page_objs(b"q 20 0 0 10 0 0 cm /im Do Q")
+    objs[4] = _flate_image(_solid(2, 2, (7, 8, 9)))
+    objs[6] = b"<< /Title (innocent 4 0 obj null string) >>"
+    arr = MiniPdf(_pdf(objs)).rasterize(1, 72)
+    assert (arr == [7, 8, 9]).all()
+
+
+def test_dct_dims_must_match_declaration():
+    """A huge JPEG behind a tiny declared Width/Height must refuse BEFORE
+    decode (in-process allocation bypass)."""
+    import io
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.new("RGB", (64, 64), (0, 0, 0)).save(buf, "JPEG")
+    head = (
+        b"/Type /XObject /Subtype /Image /Width 2 /Height 2 "
+        b"/Filter /DCTDecode /BitsPerComponent 8 /ColorSpace /DeviceRGB"
+    )
+    objs = _page_objs(b"q 20 0 0 10 0 0 cm /im Do Q")
+    objs[4] = _stream(buf.getvalue(), head)
+    with pytest.raises(PdfRefusal, match="declares"):
+        MiniPdf(_pdf(objs)).rasterize(1, 72)
+
+
+def test_decode_array_inversion_applied():
+    """/Decode [1 0] on a gray image inverts samples (scan pipelines)."""
+    objs = _page_objs(b"q 20 0 0 10 0 0 cm /im Do Q")
+    objs[4] = _flate_image(_solid(2, 2, (0,))[:, :, :1], b"/DeviceGray",
+                           b"/Decode [1 0] ")
+    arr = MiniPdf(_pdf(objs)).rasterize(1, 72)
+    assert (arr == 255).all()
+
+
+def test_clipped_image_refused():
+    """We have no clip rasterizer; painting unclipped would be silently
+    wrong vs ghostscript, so Do under an active W clip refuses."""
+    objs = _page_objs(b"0 0 5 10 re W n q 20 0 0 10 0 0 cm /im Do Q")
+    objs[4] = _flate_image(_solid(2, 2, (0, 0, 0)))
+    with pytest.raises(PdfRefusal, match="clip"):
+        MiniPdf(_pdf(objs)).rasterize(1, 72)
+
+
+def test_clip_is_restored_by_Q():
+    objs = _page_objs(
+        b"q 0 0 5 10 re W n Q q 20 0 0 10 0 0 cm /im Do Q"
+    )
+    objs[4] = _flate_image(_solid(2, 2, (3, 3, 3)))
+    arr = MiniPdf(_pdf(objs)).rasterize(1, 72)
+    assert (arr == 3).all()
+
+
+def test_extgstate_transparency_refused():
+    objs = _page_objs(b"/G gs q 20 0 0 10 0 0 cm /im Do Q")
+    objs[3] = (
+        b"<< /Type /Page /Parent 2 0 R /MediaBox [0 0 20 10]"
+        b" /Resources << /XObject << /im 4 0 R >>"
+        b" /ExtGState << /G << /ca 0.0 >> >> >> /Contents 5 0 R >>"
+    )
+    objs[4] = _flate_image(_solid(2, 2, (0, 0, 0)))
+    with pytest.raises(PdfRefusal, match="ca"):
+        MiniPdf(_pdf(objs)).rasterize(1, 72)
+
+
+def test_extgstate_benign_allowed():
+    # a gstate that only sets line width must not refuse
+    objs = _page_objs(b"/G gs q 20 0 0 10 0 0 cm /im Do Q")
+    objs[3] = (
+        b"<< /Type /Page /Parent 2 0 R /MediaBox [0 0 20 10]"
+        b" /Resources << /XObject << /im 4 0 R >>"
+        b" /ExtGState << /G << /LW 2 /ca 1.0 >> >> >> /Contents 5 0 R >>"
+    )
+    objs[4] = _flate_image(_solid(2, 2, (6, 6, 6)))
+    arr = MiniPdf(_pdf(objs)).rasterize(1, 72)
+    assert (arr == 6).all()
+
+
+def test_gigapixel_cm_scale_is_bounded_by_canvas():
+    """A hostile cm scaling the unit square to gigapixels must not allocate
+    the full rect — the blit clips to the (ceiling-checked) canvas first."""
+    objs = _page_objs(b"q 100000 0 0 100000 0 0 cm /im Do Q")
+    objs[4] = _flate_image(_solid(2, 2, (9, 9, 9)))
+    arr = MiniPdf(_pdf(objs)).rasterize(1, 96)  # completes, no giant alloc
+    assert (arr == 9).all()
+
+
+def test_negative_density_rejected_both_backends(tmp_path):
+    from flyimg_tpu.codecs.pdf import rasterize_page
+    from flyimg_tpu.exceptions import InvalidArgumentException
+
+    objs = _page_objs(b"")
+    src = tmp_path / "doc.pdf"
+    src.write_bytes(_pdf(objs))
+    with pytest.raises(InvalidArgumentException):
+        rasterize_page(str(src), str(tmp_path / "o.png"), density=-96)
+    with pytest.raises(InvalidArgumentException):
+        rasterize_page(str(src), str(tmp_path / "o.png"), density=99999)
+
+
+def test_indirect_length_defined_earlier_resolves():
+    objs = {
+        1: b"<< /Type /Catalog /Pages 2 0 R >>",
+        2: b"<< /Type /Pages /Count 1 /Kids [3 0 R] >>",
+        7: b" 27",  # Length object defined BEFORE the stream that uses it
+        3: (
+            b"<< /Type /Page /Parent 2 0 R /MediaBox [0 0 20 10]"
+            b" /Resources << /XObject << /im 4 0 R >> >> /Contents 5 0 R >>"
+        ),
+        4: _flate_image(_solid(2, 2, (4, 4, 4))),
+        5: b"<< /Length 7 0 R >>stream\nq 20 0 0 10 0 0 cm /im Do Q\nendstream\n",
+    }
+    arr = MiniPdf(_pdf(objs)).rasterize(1, 72)
+    assert (arr == 4).all()
+
+
+def test_fake_root_in_payload_does_not_shadow_trailer():
+    """'/Root N 0 R' bytes inside a stream payload (or any pre-trailer
+    position) must not shadow the real trailer's catalog pointer."""
+    objs = _page_objs(b"q 20 0 0 10 0 0 cm /im Do Q")
+    objs[4] = _flate_image(_solid(2, 2, (2, 2, 2)))
+    # a no-Length junk stream carrying a fake /Root pointing at the image
+    objs[9] = _stream(b"decoy /Root 4 0 R decoy", b"/Type /Junk")
+    arr = MiniPdf(_pdf(objs)).rasterize(1, 72)
+    assert (arr == 2).all()
+
+
+def test_zip_bomb_image_stream_refused():
+    # 2x2 declared, but the flate stream expands to megabytes
+    bomb = zlib.compress(b"\x00" * 8_000_000)
+    head = (
+        b"/Type /XObject /Subtype /Image /Width 2 /Height 2 "
+        b"/Filter /FlateDecode /BitsPerComponent 8 /ColorSpace /DeviceRGB"
+    )
+    objs = _page_objs(b"q 20 0 0 10 0 0 cm /im Do Q")
+    objs[4] = _stream(bomb, head)
+    with pytest.raises(PdfRefusal):
+        MiniPdf(_pdf(objs)).rasterize(1, 72)
