@@ -158,6 +158,61 @@ def test_non_pdf_refused():
         MiniPdf(b"GIF89a not a pdf")
 
 
+def test_fuzzed_documents_never_escape_the_exception_contract(tmp_path):
+    """Seeded structured fuzz over the whole rasterize_page_mini surface
+    (same net as the metadata parsers, test_codecs.py): bit flips,
+    truncations, splices of valid fragments into garbage. Every outcome
+    must be a clean render or an AppException-mapped refusal — a parser
+    crash on attacker bytes would be a 500 in serving."""
+    import random
+
+    from flyimg_tpu.codecs.pdf_mini import rasterize_page_mini
+    from flyimg_tpu.exceptions import AppException
+
+    rng = random.Random(0xF1)
+    objs = _page_objs(b"q 20 0 0 10 0 0 cm /im Do Q")
+    objs[4] = _flate_image(_solid(4, 4, (10, 20, 30)), b"/DeviceRGB",
+                           b"/SMask 6 0 R ")
+    objs[6] = _flate_image(_solid(4, 4, (200,))[:, :, :1], b"/DeviceGray")
+    base = _pdf(objs)
+    out_png = str(tmp_path / "out.png")
+
+    def attempt(data: bytes):
+        p = tmp_path / "fuzz.pdf"
+        p.write_bytes(data)
+        try:
+            rasterize_page_mini(str(p), out_png, page=1, density=96)
+        except AppException:
+            pass  # refusal / exec-failure: the contract
+
+    for trial in range(300):
+        data = bytearray(base)
+        mode = trial % 5
+        if mode == 0:  # random single-byte flips
+            for _ in range(rng.randrange(1, 8)):
+                data[rng.randrange(len(data))] ^= 1 << rng.randrange(8)
+        elif mode == 1:  # truncation
+            data = data[: rng.randrange(1, len(data))]
+        elif mode == 2:  # random splice of garbage
+            at = rng.randrange(len(data))
+            data[at:at] = bytes(rng.randrange(256) for _ in range(rng.randrange(1, 64)))
+        elif mode == 3:  # duplicate a random slice (fake incremental update)
+            a = rng.randrange(len(data))
+            b = rng.randrange(a, min(a + 300, len(data)))
+            data += data[a:b]
+        else:  # numeric token mutation (lengths, refs, matrices, boxes)
+            import re as _re
+
+            nums = list(_re.finditer(rb"\d+", bytes(data)))
+            if nums:
+                m = nums[rng.randrange(len(nums))]
+                repl = str(rng.choice(
+                    [0, -1, 2**31, 99999999999, rng.randrange(10000)]
+                )).encode()
+                data[m.start():m.end()] = repl
+        attempt(bytes(data))
+
+
 # -- hardening regressions (code-review findings): malformed/hostile inputs
 # must surface as refusals (-> 415 through the app status map), never 500s,
 # and never unbounded allocations.

@@ -100,8 +100,41 @@ def main() -> int:
             fh.write("\n")
 
     py = sys.executable
+
+    # Gate on a REAL computation first: round 4 found a tunnel mode where
+    # the device lists and init succeeds but the first program never
+    # returns. Without this gate every stage would burn its full timeout
+    # against a hung chip; with it, a dead window costs ~2 min and the
+    # suite records exactly why nothing else ran.
+    sys.path.insert(0, REPO)
+    from bench import _PROBE_SNIPPET  # the one compute-probe definition
+
+    probe = run_stage(
+        "compute_probe",
+        [py, "-c",
+         _PROBE_SNIPPET +
+         # the backend must BE the chip: in the fail-fast tunnel mode JAX
+         # falls back to CPU, the matmul succeeds there, and without this
+         # assert the suite would record ~80 min of CPU numbers as
+         # on-chip evidence
+         ";import jax;assert jax.default_backend() == 'tpu', jax.default_backend();"
+         "print('CHIP OK tpu')"],
+        120, results,
+    )
+    flush()
+    if probe["rc"] != 0:
+        print(json.dumps({"stages": [
+            {k: e.get(k) for k in ("stage", "rc", "seconds")} for e in results
+        ], "aborted": "compute probe failed; tunnel down or hung"}))
+        return 1
+
     if "bench" not in args.skip:
-        run_stage("bench_headline", [py, "bench.py"], 900, results)
+        # the gate just proved compute works -> skip bench's own probes;
+        # cap each bench child at 600s so worst case (hung TPU child +
+        # CPU fallback) fits inside this stage's timeout with slack
+        run_stage("bench_headline", [py, "bench.py"], 1500, results,
+                  env={"FLYIMG_BENCH_SKIP_PROBE": "1",
+                       "FLYIMG_BENCH_DEADLINE": "600"})
         flush()
     if "ops" not in args.skip:
         run_stage(
