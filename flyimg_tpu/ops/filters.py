@@ -35,18 +35,17 @@ def _gaussian_kernel(radius: float, sigma: float) -> jnp.ndarray:
     return kernel / jnp.sum(kernel)
 
 
-def _separable_conv(image: jnp.ndarray, kernel: jnp.ndarray) -> jnp.ndarray:
-    """Depthwise separable conv over [..., H, W, C] with edge replication
-    (IM's edge virtual-pixel policy)."""
+def _separable_conv_core(h_padded: jnp.ndarray, kernel: jnp.ndarray) -> jnp.ndarray:
+    """Depthwise separable conv over [N, H + 2*half, W, C] whose H axis the
+    CALLER already padded (edge rows here, halo rows in the tiled path —
+    parallel/tiling.py shares this body so the two paths cannot diverge).
+    W is edge-padded in place; both axes convolve VALID."""
     k = kernel.shape[0]
     half = k // 2
-    squeeze = image.ndim == 3
-    if squeeze:
-        image = image[None]
+    channels = h_padded.shape[-1]
     padded = jnp.pad(
-        image, ((0, 0), (half, half), (half, half), (0, 0)), mode="edge"
+        h_padded, ((0, 0), (0, 0), (half, half), (0, 0)), mode="edge"
     )
-    channels = image.shape[-1]
     # NHWC depthwise: feature_group_count = C
     kern_h = jnp.tile(kernel.reshape(k, 1, 1, 1), (1, 1, 1, channels))
     kern_w = jnp.tile(kernel.reshape(1, k, 1, 1), (1, 1, 1, channels))
@@ -56,15 +55,43 @@ def _separable_conv(image: jnp.ndarray, kernel: jnp.ndarray) -> jnp.ndarray:
         feature_group_count=channels,
     )
     dn = lax.conv_dimension_numbers(out.shape, kern_w.shape, ("NHWC", "HWIO", "NHWC"))
-    out = lax.conv_general_dilated(
+    return lax.conv_general_dilated(
         out, kern_w, (1, 1), "VALID", dimension_numbers=dn,
         feature_group_count=channels,
     )
+
+
+def _separable_conv(image: jnp.ndarray, kernel: jnp.ndarray) -> jnp.ndarray:
+    """Depthwise separable conv over [..., H, W, C] with edge replication
+    (IM's edge virtual-pixel policy)."""
+    half = kernel.shape[0] // 2
+    squeeze = image.ndim == 3
+    if squeeze:
+        image = image[None]
+    h_padded = jnp.pad(
+        image, ((0, 0), (half, half), (0, 0), (0, 0)), mode="edge"
+    )
+    out = _separable_conv_core(h_padded, kernel)
     return out[0] if squeeze else out
 
 
 def gaussian_blur(image: jnp.ndarray, radius: float, sigma: float) -> jnp.ndarray:
     return _separable_conv(image, _gaussian_kernel(radius, sigma))
+
+
+def unsharp_from_blurred(
+    image: jnp.ndarray,
+    blurred: jnp.ndarray,
+    gain: float,
+    threshold: float,
+) -> jnp.ndarray:
+    """IM UnsharpMaskImage arithmetic given the blur: amplify (img - blur)
+    where it exceeds threshold (a fraction of the [0, 255] range). Shared
+    with the tiled path (parallel/tiling.py)."""
+    diff = image - blurred
+    amount = gain * diff
+    mask = jnp.abs(diff) >= (threshold * 255.0)
+    return image + jnp.where(mask, amount, 0.0)
 
 
 def unsharp_mask(
@@ -76,11 +103,9 @@ def unsharp_mask(
 ) -> jnp.ndarray:
     """IM UnsharpMaskImage: amplify (img - blur) where it exceeds threshold.
     Pixel range is [0, 255] here; threshold is a fraction of full range."""
-    blurred = gaussian_blur(image, radius, sigma)
-    diff = image - blurred
-    amount = gain * diff
-    mask = jnp.abs(diff) >= (threshold * 255.0)
-    return image + jnp.where(mask, amount, 0.0)
+    return unsharp_from_blurred(
+        image, gaussian_blur(image, radius, sigma), gain, threshold
+    )
 
 
 def sharpen(image: jnp.ndarray, radius: float, sigma: float) -> jnp.ndarray:

@@ -1,14 +1,25 @@
-"""Spatial tiling: H-sharded image transforms with halo exchange.
+"""Spatial tiling: H-sharded image transforms with halo exchange / ring.
 
 The image-domain analog of ring/context parallelism (SURVEY.md section 5
 "long-context"): a very large image (4k+) is sharded across devices along
-its height; each device resamples its slice of the OUTPUT rows, for which it
-needs its input tile plus ``halo`` boundary rows from each neighbor —
-exchanged with ``jax.lax.ppermute`` over the mesh axis, so the traffic rides
-ICI exactly like a ring-attention block transfer.
+its height. Two communication patterns, both pure ``jax.lax.ppermute``
+over the mesh axis so the traffic rides ICI exactly like a ring-attention
+block transfer:
+
+- **halo exchange** (``tiled_transform``, ``tiled_filter``): ops whose
+  output rows need a BOUNDED neighborhood of input rows (resample kernel
+  support, convolution radius) fetch that many boundary rows from each
+  neighbor in one ppermute pair.
+- **ring accumulation** (``tiled_rotate``): rotation needs input rows
+  from arbitrarily far away (a 45-degree rotation of a tall image mixes
+  top and bottom), so tiles circulate the whole ring — n steps, O(H/n)
+  memory per device, never an all_gather — and every device accumulates
+  the bilinear taps that each visiting tile owns. This is structurally
+  the ring-attention schedule with "taps owned by the visiting block" in
+  place of attention scores.
 
 Used for the "4k -> 256 thumbnail firehose" config (BASELINE.json
-configs[4]) where a single image's resample is worth splitting across the
+configs[4]) where a single image's transform is worth splitting across the
 pod; the serving batch path (runtime/batcher.py) stays pure data-parallel.
 """
 
@@ -183,6 +194,261 @@ def _build_tiled_program(
         return jnp.einsum(
             "ow,hwc->hoc", wx, tmp, precision=jax.lax.Precision.HIGHEST,
         )
+
+    sharded = jax.shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=P(axis, None, None),
+        out_specs=P(axis, None, None),
+    )
+    return jax.jit(sharded)
+
+
+# ---------------------------------------------------------------------------
+# tiled convolution filters: halo exchange with IM's edge virtual pixels
+# ---------------------------------------------------------------------------
+
+
+def _halo_exchange_edge(tile: jnp.ndarray, halo: int, axis_name: str) -> jnp.ndarray:
+    """Like _halo_exchange, but edge devices REPLICATE their own boundary
+    row into the missing halo (ImageMagick's edge virtual-pixel policy,
+    matching ops.filters._separable_conv's mode='edge' padding)."""
+    n = jax.lax.axis_size(axis_name)
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+    bwd = [(i, (i - 1) % n) for i in range(n)]
+    from_prev = jax.lax.ppermute(tile[-halo:], axis_name, fwd)
+    from_next = jax.lax.ppermute(tile[:halo], axis_name, bwd)
+    idx = jax.lax.axis_index(axis_name)
+    top_edge = jnp.broadcast_to(tile[:1], (halo,) + tile.shape[1:])
+    bot_edge = jnp.broadcast_to(tile[-1:], (halo,) + tile.shape[1:])
+    from_prev = jnp.where(idx == 0, top_edge, from_prev)
+    from_next = jnp.where(idx == n - 1, bot_edge, from_next)
+    return jnp.concatenate([from_prev, tile, from_next], axis=0)
+
+
+def tiled_filter(
+    image: jnp.ndarray,
+    mesh: Mesh,
+    op: str,
+    radius: float,
+    sigma: float,
+    *,
+    gain: float = 1.0,
+    threshold: float = 0.05,
+    axis: str = "sp",
+) -> jnp.ndarray:
+    """Gaussian ``blur`` / ``sharpen`` / ``unsharp`` of [H, W, 3] with H
+    sharded over ``mesh[axis]`` — same semantics as ops.filters, with the
+    kernel's half-width exchanged as halo rows (one ppermute pair; the
+    bounded-neighborhood pattern, vs the ring rotate's unbounded one).
+
+    Bottom-padding for indivisible heights uses mode='edge', which IS the
+    filter's virtual-pixel policy, so sliced-off pad rows never perturb
+    true outputs.
+    """
+    from flyimg_tpu.ops.filters import _gaussian_kernel
+
+    if op not in ("blur", "sharpen", "unsharp"):
+        raise ValueError(f"unknown tiled filter op {op!r}")
+    n = int(mesh.shape[axis])
+    in_h = int(image.shape[0])
+    kernel = _gaussian_kernel(radius, sigma)
+    half = int(kernel.shape[0]) // 2
+    pad_in = (-in_h) % n
+    if half > (in_h + pad_in) // n:
+        raise ValueError(
+            f"tiled filter infeasible: kernel half-width {half} exceeds "
+            f"tile height {(in_h + pad_in) // n} over {n} devices"
+        )
+    x = image.astype(jnp.float32)
+    if pad_in:
+        x = jnp.pad(x, ((0, pad_in), (0, 0), (0, 0)), mode="edge")
+    fn = _build_tiled_filter(
+        in_h + pad_in, int(image.shape[1]), mesh, axis, op,
+        float(radius), float(sigma), float(gain), float(threshold),
+    )
+    out = fn(x)
+    return out[:in_h] if pad_in else out
+
+
+@lru_cache(maxsize=128)
+def _build_tiled_filter(
+    in_h: int, in_w: int, mesh: Mesh, axis: str, op: str,
+    radius: float, sigma: float, gain: float, threshold: float,
+):
+    from flyimg_tpu.ops.filters import _gaussian_kernel
+
+    n = int(mesh.shape[axis])
+    tile_h = in_h // n
+
+    def kernel_fn(tile):  # [tile_h, in_w, 3]
+        kern = _gaussian_kernel(radius, sigma)
+        half = kern.shape[0] // 2
+        ext = _halo_exchange_edge(tile, half, axis)  # [tile_h + 2*half, W, 3]
+        # exactly ops.filters' conv body, with the H pad rows supplied by
+        # neighbors instead of local edge replication
+        from flyimg_tpu.ops.filters import _separable_conv_core, unsharp_from_blurred
+
+        blurred = _separable_conv_core(ext[None], kern)[0]
+        if op == "blur":
+            return blurred
+        # sharpen == unsharp with gain 1, no threshold (ops.filters.sharpen)
+        eff_gain = gain if op == "unsharp" else 1.0
+        eff_threshold = threshold if op == "unsharp" else 0.0
+        return unsharp_from_blurred(tile, blurred, eff_gain, eff_threshold)
+
+    sharded = jax.shard_map(
+        kernel_fn,
+        mesh=mesh,
+        in_specs=P(axis, None, None),
+        out_specs=P(axis, None, None),
+    )
+    return jax.jit(sharded)
+
+
+# ---------------------------------------------------------------------------
+# ring rotate: all-to-all-distance gather via tile circulation
+# ---------------------------------------------------------------------------
+
+
+def tiled_rotate(
+    image: jnp.ndarray,
+    degrees: float,
+    mesh: Mesh,
+    *,
+    axis: str = "sp",
+    background=None,
+) -> jnp.ndarray:
+    """Rotate [H, W, 3] by ``degrees`` (IM convention, clockwise) with H
+    sharded over ``mesh[axis]`` — same sampling semantics as
+    ops.rotate.rotate_image (inverse-affine bilinear, clamped taps,
+    background fill), executed as an n-step ppermute ring.
+
+    Every output pixel's two y-taps are CLAMPED to the true image rows, so
+    each tap row is owned by exactly one input tile; accumulating "the taps
+    the visiting tile owns" over a full ring cycle therefore reconstructs
+    the exact single-device bilinear sum. No halo rows and no all_gather:
+    peak per-device memory is one visiting tile + one output tile.
+    """
+    from flyimg_tpu.spec.plan import rotated_bounds
+
+    quad = float(degrees) % 360.0
+    if quad == 0.0:
+        return image
+    n = int(mesh.shape[axis])
+    in_h, in_w = int(image.shape[0]), int(image.shape[1])
+    out_w, out_h = rotated_bounds(in_w, in_h, quad)
+    pad_in = (-in_h) % n
+    pad_out = (-out_h) % n
+    x = image.astype(jnp.float32)
+    if pad_in:
+        # padded rows are never sampled (taps clamp to true rows); edge
+        # mode just keeps the values finite
+        x = jnp.pad(x, ((0, pad_in), (0, 0), (0, 0)), mode="edge")
+    fn = _build_ring_rotate(
+        in_h + pad_in, in_w, quad, mesh, axis,
+        true_in_h=in_h,
+        out_hw=(out_h + pad_out, out_w),
+        true_out_hw=(out_h, out_w),
+        background=tuple(background) if background else None,
+    )
+    out = fn(x)
+    return out[:out_h] if pad_out else out
+
+
+@lru_cache(maxsize=128)
+def _build_ring_rotate(
+    in_h: int,
+    in_w: int,
+    degrees: float,
+    mesh: Mesh,
+    axis: str,
+    *,
+    true_in_h: int,
+    out_hw: Tuple[int, int],
+    true_out_hw: Tuple[int, int],
+    background,
+):
+    import math
+
+    n = int(mesh.shape[axis])
+    out_h, out_w = out_hw
+    rot_h, rot_w = true_out_hw
+    tile_h = in_h // n
+    out_tile_h = out_h // n
+    th = float(true_in_h)
+    tw = float(in_w)
+    theta = math.radians(degrees)
+    cos_t, sin_t = math.cos(theta), math.sin(theta)
+    bg = jnp.array(background or (255, 255, 255), jnp.float32)
+
+    def kernel(tile):  # [tile_h, in_w, 3] on each device
+        idx = jax.lax.axis_index(axis)
+        # my output rows, in global coordinates
+        yo, xo = jnp.meshgrid(
+            jnp.arange(out_tile_h, dtype=jnp.float32)
+            + idx.astype(jnp.float32) * out_tile_h,
+            jnp.arange(out_w, dtype=jnp.float32),
+            indexing="ij",
+        )
+        cy_out = (rot_h - 1.0) / 2.0
+        cx_out = (rot_w - 1.0) / 2.0
+        cy_in = (th - 1.0) / 2.0
+        cx_in = (tw - 1.0) / 2.0
+        dx = xo - cx_out
+        dy = yo - cy_out
+        xs = cos_t * dx + sin_t * dy + cx_in
+        ys = -sin_t * dx + cos_t * dy + cy_in
+
+        x0 = jnp.floor(xs)
+        y0 = jnp.floor(ys)
+        fx = (xs - x0)[..., None]
+        fy = (ys - y0)[..., None]
+        xc0 = jnp.clip(x0, 0.0, tw - 1.0).astype(jnp.int32)
+        xc1 = jnp.clip(x0 + 1.0, 0.0, tw - 1.0).astype(jnp.int32)
+        # clamped GLOBAL tap rows: each is owned by exactly one tile
+        yc0 = jnp.clip(y0, 0.0, th - 1.0).astype(jnp.int32)
+        yc1 = jnp.clip(y0 + 1.0, 0.0, th - 1.0).astype(jnp.int32)
+
+        def tap_rows(visit, src0, yc, wrow):
+            """Accumulate one y-tap's x-interpolated row values where the
+            visiting tile [src0, src0+tile_h) owns the tap row."""
+            local = yc - src0
+            owned = ((local >= 0) & (local < tile_h))[..., None]
+            lc = jnp.clip(local, 0, tile_h - 1)
+            row0 = visit[lc, xc0]
+            row1 = visit[lc, xc1]
+            val = row0 * (1.0 - fx) + row1 * fx
+            return jnp.where(owned, val * wrow, 0.0)
+
+        perm = [(i, (i - 1) % n) for i in range(n)]
+
+        def accumulate(visit, k, acc):
+            # at step k I hold the tile of device (idx + k) mod n
+            src0 = ((idx + k) % n) * tile_h
+            acc = acc + tap_rows(visit, src0, yc0, 1.0 - fy)
+            return acc + tap_rows(visit, src0, yc1, fy)
+
+        def step(k, carry):
+            visit, acc = carry
+            acc = accumulate(visit, k, acc)
+            visit = jax.lax.ppermute(visit, axis, perm)
+            return visit, acc
+
+        acc = jnp.zeros((out_tile_h, out_w, tile.shape[-1]), jnp.float32)
+        # the fresh zeros are unvaried over the mesh axis while the loop
+        # output varies with it; align the carry's varying-axes type
+        acc = jax.lax.pcast(acc, (axis,), to="varying")
+        # n-1 permuted steps, then the last visiting tile outside the loop:
+        # XLA can't DCE a collective in a uniform loop body, so a full-n
+        # loop would pay one extra full-tile ICI hop per rotate
+        visit, acc = jax.lax.fori_loop(0, n - 1, step, (tile, acc))
+        acc = accumulate(visit, n - 1, acc)
+
+        inside = (
+            (xs >= -0.5) & (xs <= tw - 0.5) & (ys >= -0.5) & (ys <= th - 0.5)
+        )[..., None]
+        return jnp.where(inside, acc, bg)
 
     sharded = jax.shard_map(
         kernel,

@@ -273,12 +273,18 @@ class ImageHandler:
         )
 
     def _tiled_or_none(self, frame: np.ndarray, plan: TransformPlan):
-        """Run the H-sharded halo-exchange resample when it applies:
-        a full-frame resample-only plan, a tall input divisible by the 'sp'
-        axis, and divisible output rows. Anything else -> None (batcher /
-        direct path). This is the 4k-thumbnail-firehose path
-        (BASELINE.md config 4)."""
-        if self.sp_mesh is None or plan.resize_to is None:
+        """Run an H-sharded tiled program when one applies to a tall input:
+        halo-exchange resample for full-frame resample-only plans (the
+        4k-thumbnail-firehose path, BASELINE.md config 4), ppermute-ring
+        rotate for rotate-only plans, halo-exchange conv for single-filter
+        plans. Anything else -> None (batcher / direct path); every branch
+        is an allowlist so any new pixel op fails safe to the batcher."""
+        if self.sp_mesh is None:
+            return None
+        single = self._tiled_single_op_or_none(frame, plan)
+        if single is not None:
+            return single
+        if plan.resize_to is None:
             return None
         # allowlist, not denylist: the device plan must be EXACTLY a bare
         # resample (any pixel op — present or added later — fails safe to
@@ -323,6 +329,79 @@ class ImageHandler:
             self.metrics.counter(
                 "flyimg_tiled_resamples_total",
                 "Large inputs resampled via sp-axis spatial tiling",
+            ).inc()
+        return np.asarray(
+            jnp.clip(jnp.round(out), 0.0, 255.0).astype(jnp.uint8)
+        )
+
+    def _tiled_single_op_or_none(self, frame: np.ndarray, plan: TransformPlan):
+        """Tiled execution for tall single-op plans: EXACTLY one of
+        rotate / blur / sharpen / unsharp and nothing else (no geometry
+        change, no color ops, no extract)."""
+        h = frame.shape[0]
+        if h < self.TILE_MIN_ROWS:
+            return None
+        if plan.resize_to is not None or plan.extent is not None:
+            return None
+        ops_set = [
+            name for name in ("rotate", "blur", "sharpen", "unsharp")
+            if getattr(plan, name) is not None
+        ]
+        if len(ops_set) != 1:
+            return None
+        # allowlist via device_plan, like the resample branch: the compiled
+        # plan must be EXACTLY bare + this one op (+ background, which only
+        # rotate reads when extent is None) — any other pixel-op field,
+        # present or added later, fails safe to the batcher
+        from dataclasses import replace
+
+        dp = plan.device_plan()
+        bare = TransformPlan(
+            src_size=(0, 0), resize_to=None, extent=None,
+            filter_method=plan.filter_method,
+        )
+        allowed = replace(
+            bare, background=dp.background,
+            **{ops_set[0]: getattr(dp, ops_set[0])},
+        )
+        if dp != allowed:
+            return None
+        import jax.numpy as jnp
+
+        from flyimg_tpu.parallel.tiling import tiled_filter, tiled_rotate
+
+        try:
+            op = ops_set[0]
+            if op == "rotate":
+                out = tiled_rotate(
+                    jnp.asarray(frame), float(plan.rotate), self.sp_mesh,
+                    background=plan.background,
+                )
+            elif op == "blur":
+                r, s = plan.blur
+                out = tiled_filter(
+                    jnp.asarray(frame, jnp.float32), self.sp_mesh, "blur", r, s
+                )
+            elif op == "sharpen":
+                r, s, _, _ = plan.sharpen
+                out = tiled_filter(
+                    jnp.asarray(frame, jnp.float32), self.sp_mesh,
+                    "sharpen", r, s,
+                )
+            else:
+                r, s, gain, thr = plan.unsharp
+                out = tiled_filter(
+                    jnp.asarray(frame, jnp.float32), self.sp_mesh,
+                    "unsharp", r, s, gain=gain, threshold=thr,
+                )
+        except ValueError:
+            # infeasible geometry (halo/kernel exceeds a tile) -> batcher
+            return None
+        if self.metrics is not None:
+            self.metrics.counter(
+                "flyimg_tiled_single_ops_total",
+                "Tall single-op plans run via sp-axis tiling (ring rotate / "
+                "halo conv)",
             ).inc()
         return np.asarray(
             jnp.clip(jnp.round(out), 0.0, 255.0).astype(jnp.uint8)

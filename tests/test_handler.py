@@ -918,3 +918,61 @@ def test_gif_alpha_planes_skip_value_ops(tmp_path, env):
     # outside the green patch everything stays transparent
     region = f1[25:45, 35:60, 3]
     assert region.max() == 0
+
+
+def test_tall_single_op_plans_take_tiled_path(tmp_path):
+    """Rotate-only and blur-only requests on tall inputs run the sp-axis
+    tiled programs (ring rotate / halo conv) and match the untiled path."""
+    from flyimg_tpu.parallel.mesh import make_mesh
+    from flyimg_tpu.runtime.metrics import MetricsRegistry
+
+    params = AppParameters(
+        {"upload_dir": str(tmp_path / "up"), "tmp_dir": str(tmp_path / "tmp")}
+    )
+    metrics = MetricsRegistry()
+    tiled_handler = ImageHandler(
+        make_storage(params), params, metrics=metrics,
+        sp_mesh=make_mesh(axis_names=("sp",)),
+    )
+    plain_handler = ImageHandler(
+        make_storage(AppParameters({"upload_dir": str(tmp_path / "up2"),
+                                    "tmp_dir": str(tmp_path / "tmp2")})),
+        params,
+    )
+    rng = np.random.default_rng(21)
+    arr = rng.integers(0, 256, (2048, 256, 3), dtype=np.uint8)
+    src = str(tmp_path / "tall.png")
+    Image.fromarray(arr).save(src)
+
+    for opts in ("r_-37,o_png", "blr_0x1.5,o_png"):
+        tiled = tiled_handler.process_image(opts, src)
+        plain = plain_handler.process_image(opts, src)
+        a = np.asarray(Image.open(io.BytesIO(tiled.content)), dtype=np.int16)
+        b = np.asarray(Image.open(io.BytesIO(plain.content)), dtype=np.int16)
+        assert a.shape == b.shape
+        assert np.abs(a - b).max() <= 2, opts
+    assert metrics.summary().get("flyimg_tiled_single_ops_total") == 2.0
+
+
+def test_rotate_plus_resize_skips_single_op_tiling(tmp_path):
+    """Multi-op plans must fail safe to the batcher/direct path."""
+    from flyimg_tpu.parallel.mesh import make_mesh
+    from flyimg_tpu.runtime.metrics import MetricsRegistry
+
+    params = AppParameters(
+        {"upload_dir": str(tmp_path / "up"), "tmp_dir": str(tmp_path / "tmp")}
+    )
+    metrics = MetricsRegistry()
+    handler = ImageHandler(
+        make_storage(params), params, metrics=metrics,
+        sp_mesh=make_mesh(axis_names=("sp",)),
+    )
+    rng = np.random.default_rng(22)
+    tall = str(tmp_path / "tall.png")
+    Image.fromarray(
+        rng.integers(0, 256, (2048, 256, 3), dtype=np.uint8)
+    ).save(tall)
+    handler.process_image("r_45,w_100,h_100,rz_1,o_png", tall)
+    # any extra pixel op knocks the plan off the single-op allowlist too
+    handler.process_image("clsp_gray,blr_0x1.5,o_png", tall)
+    assert "flyimg_tiled_single_ops_total" not in metrics.summary()
