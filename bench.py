@@ -6,15 +6,17 @@ crop-fill resample to 300x250 (MXU einsums, bf16 multiplies), the
 smart-crop saliency field, and the candidate-scoring conv — measured at steady state, inputs device-resident.
 
 Measurement model: K batches per device launch via ``lax.scan`` (one
-dispatch, K sequential batch programs), median over several launches. This
-amortizes host dispatch, which in this dev harness crosses a relay tunnel
-with a measured ~71 ms floor per launch — three orders of magnitude above
-real TPU dispatch (~100 us). Per-call blocking would benchmark the tunnel
-(3.2k img/s, all latency); async pipelined dispatch reaches 11.7k; the
-scan steady state is what the same program sustains on real hardware,
-where dispatch overlaps compute. Host<->device transfer is likewise
-excluded: at real interconnect rates the uint8 batch H2D adds ~2 ms/batch
-and overlaps via double buffering.
+dispatch, K sequential batch programs), timed at scan lengths K and 3K and
+DIFFERENCED (median over several launches): every per-launch constant the
+dev harness adds — relay-tunnel dispatch (measured ~70 ms floor, three
+orders of magnitude above real TPU dispatch at ~100 us) and the
+result-read roundtrip (~50 ms) — cancels in the difference, leaving the
+pure steady-state per-batch compute. Per-call blocking would benchmark
+the tunnel (3.2k img/s, all latency); the differenced scan steady state
+is what the same program sustains on real hardware, where dispatch
+overlaps compute. Host<->device transfer is likewise excluded: at real
+interconnect rates the uint8 batch H2D adds ~2 ms/batch and overlaps via
+double buffering.
 
 vs_baseline: BASELINE.md's target is >= 10_000 images/sec on a v4-8 (8
 chips) => 1_250 images/sec/chip; the printed ratio is value / 1250. (The
@@ -175,6 +177,19 @@ def main() -> None:
 
     import __graft_entry__ as graft
 
+    # arm the same persistent compile cache serving uses (app.py): through
+    # the dev tunnel a cold compile of the flagship program can eat most of
+    # the supervisor's deadline; with the cache, only the first-ever run
+    # pays it (and a deadline-killed first attempt still seeds the cache
+    # if compilation finished before the measurement phase)
+    try:
+        cache_dir = os.path.abspath("var/cache/xla")
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except OSError:
+        pass
+
     backend = jax.default_backend()
 
     global BATCH, SCAN_LEN, LAUNCHES
@@ -182,43 +197,88 @@ def main() -> None:
         # CI smoke on CPU: same program, toy sizes
         BATCH, SCAN_LEN, LAUNCHES = 16, 2, 2
 
+    def note(msg):
+        # progress to stderr: when the supervisor's deadline kills this
+        # child, the captured tail says which phase hung (H2D transfer,
+        # compile, or launches) — the tunnel has exhibited all three
+        print(f"# bench child: {msg} t={time.perf_counter() - T0:.1f}s",
+              file=sys.stderr, flush=True)
+
+    T0 = time.perf_counter()
     fn, args = graft.entry()
     # scale example args up to the bench batch
     reps = max(BATCH // args[0].shape[0], 1)
     BATCH = reps * args[0].shape[0]
+    note(f"tracing ready, transferring batch {BATCH}")
     device_args = [
         jax.device_put(np.concatenate([np.asarray(a)] * reps, axis=0))
         for a in args
     ]
+    jax.block_until_ready(device_args)
+    note("H2D done, compiling")
 
-    def body(carry, _):
-        # tie each iteration's INPUT to the carry so XLA cannot hoist the
-        # loop-invariant pipeline out of the scan (LICM would otherwise
-        # compute one batch and loop over scalar adds). isnan(carry) is 0
-        # at runtime but data-dependent, so images ^ 0 defeats CSE/LICM
-        # while leaving the pixels untouched.
-        zero = jnp.isnan(carry).astype(jnp.uint8)
-        imgs = device_args[0] ^ zero
-        out, scores = fn(imgs, *device_args[1:])
-        # consume both outputs so no batch is dead-code-eliminated
-        return carry + scores.sum() + out[..., 0].astype(jnp.float32).sum(), None
+    # The batch is a real jit PARAMETER, not a closure capture: zero-arg
+    # jit embeds closed-over arrays as program constants, and XLA will
+    # constant-fold a small enough constant program at compile time (the
+    # device_ops harness caught exactly that). The flagship is too big to
+    # fold, but the measurement must not depend on a folding threshold.
+    def make_launch(length):
+        @jax.jit
+        def launch(images, *rest):
+            def body(carry, _):
+                # tie each iteration's INPUT to the carry so XLA cannot
+                # hoist the loop-invariant pipeline out of the scan (LICM
+                # would otherwise compute one batch and loop over scalar
+                # adds). isnan(carry) is 0 at runtime but data-dependent,
+                # so images ^ 0 defeats CSE/LICM, pixels untouched.
+                zero = jnp.isnan(carry).astype(jnp.uint8)
+                out, scores = fn(images ^ zero, *rest)
+                # consume both outputs so no batch is dead-code-eliminated
+                acc = scores.sum() + out[..., 0].astype(jnp.float32).sum()
+                return carry + acc, None
 
-    @jax.jit
-    def launch():
-        acc, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=SCAN_LEN)
-        return acc
+            acc, _ = jax.lax.scan(
+                body, jnp.float32(0.0), None, length=length
+            )
+            return acc
 
-    jax.block_until_ready(launch())  # compile
+        return launch
 
-    times = []
+    # Sync by READING the scalar result: this environment's jax CPU
+    # backend can return from block_until_ready before the computation
+    # finishes (verified: 0.05 ms "launches" whose float() read then took
+    # 105 ms); a host read is the only unambiguous barrier.
+    #
+    # Two-scan differencing: in this dev harness every launch ALSO pays
+    # relay-tunnel constants (dispatch ~70 ms + the scalar-read roundtrip
+    # ~50 ms) that real TPU serving does not (its dispatch is ~100 us and
+    # overlaps compute). Timing the same program at scan lengths L and 3L
+    # and differencing cancels every per-launch constant, leaving the pure
+    # steady-state per-batch compute the docstring's measurement model
+    # promises.
+    launch_1 = make_launch(SCAN_LEN)
+    launch_3 = make_launch(3 * SCAN_LEN)
+    float(launch_1(*device_args))  # compile
+    float(launch_3(*device_args))
+    note("compiled, measuring")
+
+    t1s, t3s = [], []
     for step in range(WARMUP + LAUNCHES):
         start = time.perf_counter()
-        jax.block_until_ready(launch())
-        elapsed = time.perf_counter() - start
+        float(launch_1(*device_args))
+        mid = time.perf_counter()
+        float(launch_3(*device_args))
+        end = time.perf_counter()
+        note(f"launch {step} scan1={mid - start:.3f}s scan3={end - mid:.3f}s")
         if step >= WARMUP:
-            times.append(elapsed)
+            t1s.append(mid - start)
+            t3s.append(end - mid)
 
-    per_batch = float(np.median(times)) / SCAN_LEN
+    dt = float(np.median(t3s)) - float(np.median(t1s))
+    if dt <= 0:  # degenerate timing (noise > work): fall back to a bound
+        per_batch = float(np.median(t1s)) / SCAN_LEN
+    else:
+        per_batch = dt / (2 * SCAN_LEN)
     images_per_sec = BATCH / per_batch
     print(
         json.dumps(

@@ -29,32 +29,63 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def _steady_state(fn, args, batch: int, scan: int, launches: int = 4):
     """Median images/sec of `fn(*args)` run `scan` times per device launch
-    (carry-xor defeats LICM/CSE the same way bench.py does)."""
+    (carry-xor defeats LICM/CSE the same way bench.py does).
+
+    The inputs MUST be real jit parameters, not closure captures: a
+    zero-arg jit embeds them as program constants, and for small enough
+    op chains XLA constant-folds the whole scan at compile time — the
+    round-4 device_ops first capture recorded 75M img/s "rotate" that
+    way (a fetch of a precomputed scalar, not a measurement)."""
     import jax
     import jax.numpy as jnp
 
-    def body(carry, _):
-        zero = jnp.isnan(carry).astype(jnp.uint8)
-        first = args[0] ^ zero
-        out = fn(first, *args[1:])
-        if isinstance(out, tuple):
-            acc = sum(o.astype(jnp.float32).sum() for o in out)
-        else:
-            acc = out.astype(jnp.float32).sum()
-        return carry + acc, None
+    def make_launch(length):
+        @jax.jit
+        def launch(first_arg, *rest):
+            def body(carry, _):
+                zero = jnp.isnan(carry).astype(jnp.uint8)
+                out = fn(first_arg ^ zero, *rest)
+                if isinstance(out, tuple):
+                    acc = sum(o.astype(jnp.float32).sum() for o in out)
+                else:
+                    acc = out.astype(jnp.float32).sum()
+                return carry + acc, None
 
-    @jax.jit
-    def launch():
-        acc, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=scan)
-        return acc
+            acc, _ = jax.lax.scan(
+                body, jnp.float32(0.0), None, length=length
+            )
+            return acc
 
-    jax.block_until_ready(launch())
-    times = []
-    for _ in range(launches):
-        t = time.perf_counter()
-        jax.block_until_ready(launch())
-        times.append(time.perf_counter() - t)
-    return batch / (float(np.median(times)) / scan)
+        return launch
+
+    # sync by READING the scalar, not block_until_ready: this environment's
+    # jax CPU backend returns from block_until_ready before the computation
+    # finishes (measured 0.05 ms "launches" whose float() read then took
+    # 105 ms), which is exactly how the first device_ops capture recorded
+    # 75M img/s rotates. A host read of the result is unambiguous.
+    #
+    # Two-scan differencing: each launch pays a fixed dispatch cost (the
+    # dev harness relays every call, measured ~71 ms floor with tens of ms
+    # of jitter) plus scan x per-iteration work. For small ops the floor
+    # swamps the work at any fixed scan, so measure at scan and 7*scan and
+    # difference — the floor cancels and the rate is the op's own. The 7x
+    # spread keeps the differenced work (6*scan iterations) well above the
+    # floor's jitter.
+    def timed(launch_fn):
+        float(launch_fn(*args))  # compile + warm
+        ts = []
+        for _ in range(max(launches, 6)):
+            t = time.perf_counter()
+            float(launch_fn(*args))
+            ts.append(time.perf_counter() - t)
+        return float(np.median(ts))
+
+    t1 = timed(make_launch(scan))
+    t7 = timed(make_launch(7 * scan))
+    dt = t7 - t1
+    if dt <= 0:  # noise floor: fall back to the single-scan bound
+        return batch / (t1 / scan)
+    return batch / (dt / (6 * scan))
 
 
 def host_codec_rows(quick: bool = False) -> list:
@@ -154,11 +185,16 @@ def main() -> int:
 
     # probe the backend out-of-process with CPU fallback (bench.py's
     # hardening): a dead TPU tunnel can HANG in-process client creation
-    from bench import _init_backend
+    from bench import _probe_backend
 
-    backend = _init_backend()
+    if not _probe_backend():
+        from flyimg_tpu.parallel.mesh import force_cpu_platform
+
+        force_cpu_platform(1)
 
     import jax
+
+    backend = jax.default_backend()
     import jax.numpy as jnp
 
     from flyimg_tpu.ops.compose import make_program_fn, plan_layout

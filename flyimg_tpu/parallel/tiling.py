@@ -35,9 +35,15 @@ from jax.sharding import Mesh, PartitionSpec as P
 from flyimg_tpu.ops.resample import resample_matrix
 
 
-def _halo_exchange(tile: jnp.ndarray, halo: int, axis_name: str) -> jnp.ndarray:
+def _halo_exchange(
+    tile: jnp.ndarray, halo: int, axis_name: str, fill: str = "zero"
+) -> jnp.ndarray:
     """Concatenate ``halo`` rows from the previous/next device around the
-    local tile. Edge devices receive zeros (masked out of the weights)."""
+    local tile. At the image's outer edges (device 0's top, device n-1's
+    bottom) the ring wraps, so those halos are replaced per ``fill``:
+    ``"zero"`` (masked out of resample weights) or ``"edge"`` (replicate
+    the boundary row — ImageMagick's edge virtual-pixel policy, matching
+    ops.filters._separable_conv's mode='edge' padding)."""
     n = jax.lax.axis_size(axis_name)
     fwd = [(i, (i + 1) % n) for i in range(n)]
     bwd = [(i, (i - 1) % n) for i in range(n)]
@@ -45,9 +51,14 @@ def _halo_exchange(tile: jnp.ndarray, halo: int, axis_name: str) -> jnp.ndarray:
     from_prev = jax.lax.ppermute(tile[-halo:], axis_name, fwd)
     from_next = jax.lax.ppermute(tile[:halo], axis_name, bwd)
     idx = jax.lax.axis_index(axis_name)
-    # zero the wrapped halos at the edges of the image
-    from_prev = jnp.where(idx == 0, jnp.zeros_like(from_prev), from_prev)
-    from_next = jnp.where(idx == n - 1, jnp.zeros_like(from_next), from_next)
+    if fill == "edge":
+        top_fill = jnp.broadcast_to(tile[:1], (halo,) + tile.shape[1:])
+        bot_fill = jnp.broadcast_to(tile[-1:], (halo,) + tile.shape[1:])
+    else:
+        top_fill = jnp.zeros_like(from_prev)
+        bot_fill = jnp.zeros_like(from_next)
+    from_prev = jnp.where(idx == 0, top_fill, from_prev)
+    from_next = jnp.where(idx == n - 1, bot_fill, from_next)
     return jnp.concatenate([from_prev, tile, from_next], axis=0)
 
 
@@ -209,23 +220,6 @@ def _build_tiled_program(
 # ---------------------------------------------------------------------------
 
 
-def _halo_exchange_edge(tile: jnp.ndarray, halo: int, axis_name: str) -> jnp.ndarray:
-    """Like _halo_exchange, but edge devices REPLICATE their own boundary
-    row into the missing halo (ImageMagick's edge virtual-pixel policy,
-    matching ops.filters._separable_conv's mode='edge' padding)."""
-    n = jax.lax.axis_size(axis_name)
-    fwd = [(i, (i + 1) % n) for i in range(n)]
-    bwd = [(i, (i - 1) % n) for i in range(n)]
-    from_prev = jax.lax.ppermute(tile[-halo:], axis_name, fwd)
-    from_next = jax.lax.ppermute(tile[:halo], axis_name, bwd)
-    idx = jax.lax.axis_index(axis_name)
-    top_edge = jnp.broadcast_to(tile[:1], (halo,) + tile.shape[1:])
-    bot_edge = jnp.broadcast_to(tile[-1:], (halo,) + tile.shape[1:])
-    from_prev = jnp.where(idx == 0, top_edge, from_prev)
-    from_next = jnp.where(idx == n - 1, bot_edge, from_next)
-    return jnp.concatenate([from_prev, tile, from_next], axis=0)
-
-
 def tiled_filter(
     image: jnp.ndarray,
     mesh: Mesh,
@@ -284,7 +278,7 @@ def _build_tiled_filter(
     def kernel_fn(tile):  # [tile_h, in_w, 3]
         kern = _gaussian_kernel(radius, sigma)
         half = kern.shape[0] // 2
-        ext = _halo_exchange_edge(tile, half, axis)  # [tile_h + 2*half, W, 3]
+        ext = _halo_exchange(tile, half, axis, fill="edge")  # [tile_h+2*half, W, 3]
         # exactly ops.filters' conv body, with the H pad rows supplied by
         # neighbors instead of local edge replication
         from flyimg_tpu.ops.filters import _separable_conv_core, unsharp_from_blurred

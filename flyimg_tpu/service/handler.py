@@ -341,7 +341,15 @@ class ImageHandler:
         h = frame.shape[0]
         if h < self.TILE_MIN_ROWS:
             return None
-        if plan.resize_to is not None or plan.extent is not None:
+        # extract must fail-safe here explicitly: device_plan() zeroes the
+        # extract field (it is applied as a resample-window pre-pass), so
+        # the dp == allowed check below cannot see it — without this guard
+        # an e_1 + single-op request would run the op on the UNcropped frame
+        if (
+            plan.resize_to is not None
+            or plan.extent is not None
+            or plan.extract is not None
+        ):
             return None
         ops_set = [
             name for name in ("rotate", "blur", "sharpen", "unsharp")

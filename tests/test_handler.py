@@ -976,3 +976,31 @@ def test_rotate_plus_resize_skips_single_op_tiling(tmp_path):
     # any extra pixel op knocks the plan off the single-op allowlist too
     handler.process_image("clsp_gray,blr_0x1.5,o_png", tall)
     assert "flyimg_tiled_single_ops_total" not in metrics.summary()
+
+
+def test_extract_plus_single_op_skips_tiling_and_crops(tmp_path):
+    """device_plan() zeroes extract (it becomes the resample window), so
+    the single-op allowlist cannot see it — the explicit guard must fail
+    safe or e_1 + blur would blur the UNcropped full frame."""
+    from flyimg_tpu.parallel.mesh import make_mesh
+    from flyimg_tpu.runtime.metrics import MetricsRegistry
+
+    params = AppParameters(
+        {"upload_dir": str(tmp_path / "up"), "tmp_dir": str(tmp_path / "tmp")}
+    )
+    metrics = MetricsRegistry()
+    handler = ImageHandler(
+        make_storage(params), params, metrics=metrics,
+        sp_mesh=make_mesh(axis_names=("sp",)),
+    )
+    rng = np.random.default_rng(23)
+    tall = str(tmp_path / "tall.png")
+    Image.fromarray(
+        rng.integers(0, 256, (2048, 256, 3), dtype=np.uint8)
+    ).save(tall)
+    out = handler.process_image(
+        "e_1,p1x_10,p1y_20,p2x_110,p2y_220,blr_0x1.5,o_png", tall
+    )
+    img = np.asarray(Image.open(io.BytesIO(out.content)))
+    assert img.shape[:2] == (200, 100)  # the extract window, not 2048x256
+    assert "flyimg_tiled_single_ops_total" not in metrics.summary()
