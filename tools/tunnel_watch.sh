@@ -1,0 +1,29 @@
+#!/bin/bash
+# Poll the TPU tunnel; when a real computation succeeds, capture the two
+# artifacts still pending from the round-4 harness fix in one window:
+#   1. device_ops_r4.json with the fixed (fold-proof, differenced) harness
+#   2. a differenced-methodology headline bench confirmation
+# Exits after one successful capture, or after MAX_POLLS.
+cd "$(dirname "$0")/.." || exit 1
+MAX_POLLS=${MAX_POLLS:-40}
+for i in $(seq 1 "$MAX_POLLS"); do
+  if timeout 90 python -c "
+import jax, jax.numpy as jnp
+assert jax.default_backend() == 'tpu'
+assert float((jnp.ones((8,8)) @ jnp.ones((8,8))).sum()) == 512.0
+" 2>/dev/null; then
+    echo "tunnel up at $(date), capturing" >&2
+    timeout 2400 python benchmarks/bench_ops.py \
+      --out benchmarks/device_ops_r4.json 2>>var/tmp/tunnel_watch.log
+    echo "bench_ops rc=$?" >&2
+    FLYIMG_BENCH_SKIP_PROBE=1 FLYIMG_BENCH_DEADLINE=900 timeout 1000 \
+      python bench.py 2>>var/tmp/tunnel_watch.log \
+      | tee benchmarks/bench_tpu_differenced_r4.jsonl
+    echo "bench rc=$?" >&2
+    exit 0
+  fi
+  echo "poll $i: tunnel down at $(date)" >&2
+  sleep 600
+done
+echo "gave up after $MAX_POLLS polls" >&2
+exit 1
