@@ -27,6 +27,7 @@ import os
 import sys
 import time
 from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FuturesTimeout
 from typing import Dict, Optional
 
 IMAGE_EXTENSIONS = (".jpg", ".jpeg", ".png", ".webp", ".gif")
@@ -134,12 +135,36 @@ def bulk_process(
     try:
         with ThreadPoolExecutor(max_workers=workers) as pool:
             futures = {pool.submit(run_one, n): n for n in names}
+            retry: list = []
             for fut, name in futures.items():
                 try:
                     fut.result()
+                except (TimeoutError, FuturesTimeout):
+                    # transient device-wait expiry (seen when the dev
+                    # tunnel hiccups mid-sweep): retry once after the
+                    # first pass drains, sequentially. FuturesTimeout is
+                    # what Future.result(timeout=) raises; it only became
+                    # the builtin TimeoutError in Python 3.11, and 3.10
+                    # is supported.
+                    retry.append(name)
                 except Exception as exc:
                     failed += 1
                     print(f"# {name}: {type(exc).__name__}: {exc}",
+                          file=sys.stderr)
+            if retry and len(retry) == len(names):
+                # EVERY job timed out: the device is down, not hiccuping.
+                # Retrying would serialize len(names) more bounded waits
+                # (hours on a big sweep) to learn the same thing.
+                failed += len(retry)
+                print(f"# all {len(retry)} jobs timed out; device down — "
+                      "skipping retry pass", file=sys.stderr)
+                retry = []
+            for name in retry:
+                try:
+                    run_one(name)
+                except Exception as exc:
+                    failed += 1
+                    print(f"# {name} (retry): {type(exc).__name__}: {exc}",
                           file=sys.stderr)
         elapsed = time.perf_counter() - t0
         stats = batcher.stats()

@@ -75,3 +75,61 @@ def test_bulk_matches_serving_transform_for_post_pass_options(tmp_path):
         (src / "img0.png").read_bytes(), OptionsBag(opts), spec
     )
     assert bulk_bytes == serve_bytes
+
+
+def test_bulk_retries_transient_timeouts_once(tmp_path, monkeypatch):
+    """A device-wait timeout (seen when the dev tunnel hiccups mid-sweep)
+    gets ONE sequential retry; a persistent timeout still counts as
+    failed. Injects concurrent.futures.TimeoutError — the type
+    Future.result(timeout=) actually raises, which is NOT the builtin
+    TimeoutError on Python 3.10."""
+    from concurrent.futures import TimeoutError as FuturesTimeout
+
+    from flyimg_tpu.service.handler import ImageHandler
+
+    src = _make_dir(tmp_path, n=3)
+    out = tmp_path / "out"
+    real = ImageHandler.transform_bytes
+    calls: dict = {}
+
+    def flaky(self, data, options, spec):
+        n = calls[spec.name] = calls.get(spec.name, 0) + 1
+        # img0 flakes once then recovers; img2 times out forever; img1
+        # succeeds outright (if every first call timed out, the
+        # all-timed-out bail below would correctly skip the retry pass)
+        if (spec.name == "img0.png" and n == 1) or spec.name == "img2.png":
+            raise FuturesTimeout("injected device wait expiry")
+        return real(self, data, options, spec)
+
+    monkeypatch.setattr(ImageHandler, "transform_bytes", flaky)
+    summary = bulk_process(
+        str(src), str(out), "w_50", out_format="png", workers=2
+    )
+    assert summary["failed"] == 1  # img2: timed out on retry too
+    assert summary["images"] == 2
+    assert sorted(os.listdir(out)) == ["img0.png", "img1.png"]
+    assert calls["img0.png"] == 2  # flaked once, recovered on retry
+    assert calls["img2.png"] == 2  # exactly one retry, no loops
+
+
+def test_bulk_skips_retry_pass_when_every_job_times_out(tmp_path, monkeypatch):
+    """All-timed-out means the device is down, not hiccuping: the retry
+    pass must bail instead of serializing N more bounded waits."""
+    from concurrent.futures import TimeoutError as FuturesTimeout
+
+    from flyimg_tpu.service.handler import ImageHandler
+
+    src = _make_dir(tmp_path, n=3)
+    out = tmp_path / "out"
+    calls: dict = {}
+
+    def dead(self, data, options, spec):
+        calls[spec.name] = calls.get(spec.name, 0) + 1
+        raise FuturesTimeout("device down")
+
+    monkeypatch.setattr(ImageHandler, "transform_bytes", dead)
+    summary = bulk_process(
+        str(src), str(out), "w_50", out_format="png", workers=2
+    )
+    assert summary["failed"] == 3 and summary["images"] == 0
+    assert all(n == 1 for n in calls.values())  # no retry pass ran
