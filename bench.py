@@ -45,12 +45,12 @@ BENCH_DEADLINE_S = float(os.environ.get("FLYIMG_BENCH_DEADLINE", "1200"))
 
 # The probe must run a real computation, not just init: round 4 found a
 # tunnel mode where jax.devices() lists the chip and client creation
-# succeeds, but the first executed program never returns. A backend that
-# cannot finish an 8x8 matmul within the timeout is down, whatever
-# jax.default_backend() says.
-_PROBE_SNIPPET = (
-    "import jax, jax.numpy as jnp;"
-    "jax.block_until_ready(jnp.ones((8,8)) @ jnp.ones((8,8)))"
+# succeeds, but the first executed program never returns. The ONE probe
+# definition lives in flyimg_tpu.parallel.mesh (shared with the serving
+# boot guard and tools/chip_suite.py); the import touches no backend.
+from flyimg_tpu.parallel.mesh import (  # noqa: E402
+    COMPUTE_PROBE_SNIPPET as _PROBE_SNIPPET,
+    probe_selected_backend,
 )
 
 
@@ -94,8 +94,7 @@ def _run_abandonable(cmd, timeout_s, env=None, capture=False):
 
 
 def _probe_backend(timeout_s: float = PROBE_TIMEOUT_S) -> bool:
-    rc, _ = _run_abandonable([sys.executable, "-c", _PROBE_SNIPPET], timeout_s)
-    return rc == 0
+    return probe_selected_backend(timeout_s)
 
 
 def _supervise() -> None:

@@ -69,6 +69,112 @@ def ensure_env_platform() -> None:
         jax.config.update("jax_platforms", req)
 
 
+# The one compute-probe definition (bench.py and tools/chip_suite.py build
+# on it): a backend that cannot finish an 8x8 matmul is down, whatever
+# jax.devices() or client init says.
+COMPUTE_PROBE_SNIPPET = (
+    "import jax, jax.numpy as jnp;"
+    "assert float((jnp.ones((8,8)) @ jnp.ones((8,8))).sum()) == 512.0"
+)
+
+
+def probe_selected_backend(timeout_s: float) -> bool:
+    """Run the compute probe in a disposable child against the SAME
+    platform selection this process would use (the child re-applies the
+    env pin via ensure_env_platform — its own sitecustomize would
+    otherwise override the inherited env var). True iff the probe child
+    exits 0 within the deadline.
+
+    Popen + poll + ABANDON on expiry: a tunnel-hung child can sit in
+    uninterruptible kernel I/O where even SIGKILL doesn't reap it, and a
+    post-kill wait() would hang the caller this probe is guarding. The
+    common killable case is reaped by a daemon thread so no zombie
+    outlives a long-running server."""
+    import subprocess
+    import sys
+    import threading
+    import time
+
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    probe = (
+        f"import sys; sys.path.insert(0, {repo_root!r});"
+        "from flyimg_tpu.parallel.mesh import ensure_env_platform;"
+        "ensure_env_platform();" + COMPUTE_PROBE_SNIPPET
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", probe],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    deadline = time.monotonic() + timeout_s
+    rc = None
+    while time.monotonic() < deadline:
+        rc = proc.poll()
+        if rc is not None:
+            break
+        time.sleep(0.25)
+    if rc is None:
+        # a child can finish during the last sleep: one final poll before
+        # declaring it hung, or a passing probe gets demoted to fallback
+        rc = proc.poll()
+    if rc is None:
+        proc.kill()
+        threading.Thread(target=proc.wait, daemon=True).start()
+    return rc == 0
+
+
+def ensure_live_backend(timeout_s: float = 75.0) -> str:
+    """Boot-time backend selection that cannot hang the server.
+
+    If the operator pinned ``JAX_PLATFORMS``, honor it (ensure_env_platform)
+    and return it. Otherwise probe the DEFAULT backend with a real
+    computation in a disposable subprocess — the dev tunnel has a mode
+    where the device lists and client init succeeds but the first executed
+    program never returns, which would wedge serving at boot forever (the
+    reference's nginx+php always boots; so must this). On probe failure,
+    force the local CPU platform and serve degraded.
+
+    A ``JAX_PLATFORMS`` pin selects the platform but does NOT bypass the
+    probe unless it is cpu-only: the wedge this guards against lives on
+    the accelerator path, and the env var cannot be trusted as operator
+    intent anyway (this environment's harness exports JAX_PLATFORMS=axon
+    globally). Operators who prefer hanging to degrading set
+    ``backend_probe_timeout_s: 0``.
+
+    ``timeout_s <= 0`` skips the probe (trust the selection as-is).
+    Returns the platform string that will serve, for the boot log.
+    """
+    req = os.environ.get("JAX_PLATFORMS", "").strip()
+    req_label = req or "default"
+    platforms = {p.strip().lower() for p in req.split(",") if p.strip()}
+    if req and platforms <= {"cpu"}:
+        ensure_env_platform()
+        return req
+    if timeout_s <= 0:
+        if req:
+            ensure_env_platform()
+        return req_label
+    if probe_selected_backend(timeout_s):
+        if req:
+            ensure_env_platform()
+        return req_label
+    import logging
+
+    logging.getLogger(__name__).warning(
+        "backend selection %r failed the boot compute probe within %.0fs; "
+        "serving on CPU fallback", req_label, timeout_s,
+    )
+    # preserve an operator's virtual CPU fan-out request, like the cpu-pin
+    # path in ensure_env_platform does
+    m = re.search(
+        r"--xla_force_host_platform_device_count=(\d+)",
+        os.environ.get("XLA_FLAGS", ""),
+    )
+    force_cpu_platform(int(m.group(1)) if m else 1)
+    return "cpu-fallback"
+
+
 def make_mesh(
     axis_sizes: Optional[Tuple[int, ...]] = None,
     axis_names: Sequence[str] = ("data",),

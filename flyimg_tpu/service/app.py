@@ -124,12 +124,24 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
     metrics = MetricsRegistry()
     import jax
 
-    from flyimg_tpu.parallel.mesh import ensure_env_platform
+    from flyimg_tpu.parallel.mesh import ensure_live_backend
 
-    # honor an operator's JAX_PLATFORMS request BEFORE any device query:
-    # without this, a cpu-only deployment still initializes the
-    # accelerator plugin at boot (and hangs if its transport is down)
-    ensure_env_platform()
+    # Backend selection BEFORE any device query. A cpu-only JAX_PLATFORMS
+    # pin boots instantly; ANY selection that includes an accelerator —
+    # pinned or default — must first pass a deadline-bounded compute probe
+    # in a subprocess, because the accelerator transport has a failure
+    # mode where client init succeeds and the first program hangs, which
+    # would wedge boot forever. Probe failure demotes the selection to
+    # CPU fallback, loudly, rather than not serving. Operators who prefer
+    # hanging to degrading set backend_probe_timeout_s: 0.
+    chosen = ensure_live_backend(
+        float(params.by_key("backend_probe_timeout_s", 75.0))
+    )
+    if chosen == "cpu-fallback":
+        metrics.counter(
+            "flyimg_boot_backend_fallbacks_total",
+            "Boot-time compute probe failed; serving on CPU",
+        ).inc()
 
     # persistent XLA compilation cache: programs compiled once survive
     # process restarts, so a redeployed server doesn't pay the 20-40 s

@@ -256,3 +256,86 @@ def test_tiled_filter_infeasible_kernel_raises():
     img = jnp.zeros((16, 16, 3), jnp.float32)  # tile_h = 2, sigma 8 -> half 24
     with pytest.raises(ValueError, match="infeasible"):
         tiled_filter(img, mesh, "blur", 0.0, 8.0)
+
+
+def test_ensure_live_backend_honors_cpu_pin_and_skips_probe(monkeypatch):
+    """A cpu-only JAX_PLATFORMS pin boots instantly, no probe subprocess
+    — there is no accelerator transport to wedge on."""
+    import subprocess
+
+    from flyimg_tpu.parallel import mesh as mesh_mod
+
+    def boom(*a, **k):
+        raise AssertionError("probe must not run for a cpu-only pin")
+
+    monkeypatch.setattr(subprocess, "Popen", boom)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert mesh_mod.ensure_live_backend(75.0) == "cpu"
+
+
+def test_ensure_live_backend_probes_accelerator_pin(monkeypatch):
+    """A non-cpu pin still gets the hang guard: this environment's harness
+    exports JAX_PLATFORMS=axon globally, so the env var cannot be read as
+    'the operator accepts a wedged boot'. Probe failure => CPU fallback."""
+    import subprocess
+
+    from flyimg_tpu.parallel import mesh as mesh_mod
+
+    class FakeProc:
+        def __init__(self, *a, **k):
+            pass
+
+        def poll(self):
+            return 1
+
+        def kill(self):
+            pass
+
+    forced = []
+    monkeypatch.setattr(subprocess, "Popen", FakeProc)
+    monkeypatch.setattr(mesh_mod, "force_cpu_platform",
+                        lambda n=1: forced.append(n))
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    monkeypatch.setenv("XLA_FLAGS", "")
+    assert mesh_mod.ensure_live_backend(5.0) == "cpu-fallback"
+    assert forced == [1]
+    # an operator's virtual CPU fan-out request survives the fallback
+    forced.clear()
+    monkeypatch.setenv(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=4"
+    )
+    assert mesh_mod.ensure_live_backend(5.0) == "cpu-fallback"
+    assert forced == [4]
+
+
+def test_ensure_live_backend_falls_back_when_probe_fails(monkeypatch):
+    """No pin + a default backend that cannot finish a computation =>
+    force CPU and report the fallback (a wedged accelerator transport
+    must degrade the server, not wedge its boot)."""
+    import subprocess
+
+    from flyimg_tpu.parallel import mesh as mesh_mod
+
+    class FakeProc:
+        def __init__(self, *a, **k):
+            pass
+
+        def poll(self):
+            return 1  # probe child exits nonzero immediately
+
+        def kill(self):
+            pass
+
+    forced = []
+    monkeypatch.setattr(subprocess, "Popen", FakeProc)
+    monkeypatch.setattr(mesh_mod, "force_cpu_platform",
+                        lambda n=1: forced.append(n))
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.setenv("XLA_FLAGS", "")
+    assert mesh_mod.ensure_live_backend(5.0) == "cpu-fallback"
+    assert forced == [1]
+    # timeout_s<=0 trusts the default backend, no probe, no fallback
+    monkeypatch.setattr(subprocess, "Popen",
+                        lambda *a, **k: (_ for _ in ()).throw(
+                            AssertionError("probe must not run")))
+    assert mesh_mod.ensure_live_backend(0) == "default"
