@@ -1,0 +1,109 @@
+"""Compute the end-to-end images/sec/chip budget from committed artifacts.
+
+BASELINE's ">=10k img/s sustained" is an end-to-end claim: decode on the
+host, transform+score on the chip, encode on the host. The chip side is
+measured (bench + tail experiment); the host side is measured per core
+(host codec rows). This tool derives the e2e budget those measurements
+imply — where the wall is, and how many host cores feed one chip — and
+writes it as one artifact so the numbers stay consistent whenever either
+input regenerates.
+
+Pipeline model (miss path, steady state, stages overlapped):
+    rate(N_cores) = min(device_rate,
+                        N_dec_cores * decode_rate,
+                        N_enc_cores * encode_rate)
+with N_dec + N_enc = N and the split chosen optimally; equivalently the
+host-side rate of one core running both stages is 1/(1/dec + 1/enc) and
+host rate scales ~linearly with cores (the native pool decodes and
+encodes without the GIL).
+
+Usage: python tools/e2e_budget.py [--out benchmarks/e2e_budget_r4.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load(rel):
+    with open(os.path.join(REPO, rel)) as fh:
+        return json.load(fh)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="benchmarks/e2e_budget_r4.json")
+    args = ap.parse_args()
+
+    host = {r["op"]: r.get("images_per_sec")
+            for r in load("benchmarks/host_codec_r4.json")["results"]}
+    manual = load("benchmarks/bench_tpu_r4_manual.json")
+    device_rate = manual["runs"][-1]["line"]["value"]
+
+    # serving shape: decode the 512^2 source, encode the 300x250 output
+    dec = host["jpeg_decode_512_1thread"]
+    enc_trellis = host["jpeg_encode_trellis_300x250_1thread"]
+    enc_plain = host["jpeg_encode_plain_300x250_1thread"]
+
+    rows = []
+    for enc_name, enc in (("trellis (moz_1, default)", enc_trellis),
+                          ("plain optimized (moz_0)", enc_plain)):
+        core_rate = 1.0 / (1.0 / dec + 1.0 / enc)
+        cores_for_chip = device_rate / core_rate
+        rows.append({
+            "encoder": enc_name,
+            "host_core_e2e_img_s": round(core_rate, 1),
+            "cores_to_saturate_one_chip": round(cores_for_chip, 1),
+            "e2e_img_s_on_16_cores": round(min(device_rate,
+                                               16 * core_rate), 1),
+            "e2e_img_s_on_64_cores": round(min(device_rate,
+                                               64 * core_rate), 1),
+            "baseline_1250_cores_needed": round(1250.0 / core_rate, 1),
+        })
+
+    doc = {
+        "what": ("End-to-end img/s/chip budget derived from committed "
+                 "measurements (see module docstring for the pipeline "
+                 "model). Host rates are the NOISE-content floor on this "
+                 "1-core build host; photographic content measured ~3x "
+                 "faster through the trellis DP (benchmarks/README.md)."),
+        "inputs": {
+            "device_rate_img_s_chip": device_rate,
+            "decode_512_img_s_core": dec,
+            "encode_trellis_300x250_img_s_core": enc_trellis,
+            "encode_plain_300x250_img_s_core": enc_plain,
+        },
+        "budget": rows,
+        "conclusions": [
+            ("The chip is never the wall: one chip sustains "
+             f"{device_rate:,.0f} img/s device-side vs the 1,250 target."),
+            (f"The BASELINE 1,250 img/s/chip end-to-end needs "
+             f"~{rows[0]['baseline_1250_cores_needed']:.0f} host cores "
+             f"with trellis on noise content "
+             f"(~{rows[0]['baseline_1250_cores_needed']/3:.0f} on photos), "
+             f"or ~{rows[1]['baseline_1250_cores_needed']:.0f} with plain "
+             "optimized encode — ordinary serving-host core counts."),
+            ("Saturating the full 17k device rate requires a pool of "
+             f"~{rows[1]['cores_to_saturate_one_chip']:.0f}+ cores (plain) "
+             "— the host codec, not the TPU, bounds this framework, the "
+             "reverse of the reference (whose wall was per-request "
+             "ImageMagick processes)."),
+        ],
+    }
+    out = os.path.join(REPO, args.out)
+    with open(out, "w") as fh:
+        json.dump(doc, fh, indent=1)
+        fh.write("\n")
+    print(json.dumps(doc["budget"], indent=1))
+    for c in doc["conclusions"]:
+        print("-", c)
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
