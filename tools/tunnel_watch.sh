@@ -7,10 +7,14 @@
 cd "$(dirname "$0")/.." || exit 1
 MAX_POLLS=${MAX_POLLS:-40}
 for i in $(seq 1 "$MAX_POLLS"); do
-  if timeout 90 python -c "
-import jax, jax.numpy as jnp
-assert jax.default_backend() == 'tpu'
-assert float((jnp.ones((8,8)) @ jnp.ones((8,8))).sum()) == 512.0
+  # probe via the repo's ABANDONABLE prober: a plain `timeout N python`
+  # wedged this loop once — GNU timeout waits for the child after
+  # signaling it, and a tunnel-hung child can be unkillable.
+  # probe_selected_backend kills best-effort and abandons.
+  if python -c "
+import sys; sys.path.insert(0, '.')
+from flyimg_tpu.parallel.mesh import probe_selected_backend
+sys.exit(0 if probe_selected_backend(90.0) else 1)
 " 2>/dev/null; then
     echo "tunnel up at $(date), capturing" >&2
     timeout 2400 python benchmarks/bench_ops.py \
