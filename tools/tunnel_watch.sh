@@ -5,6 +5,7 @@
 #   2. a differenced-methodology headline bench confirmation
 # Exits after one successful capture, or after MAX_POLLS.
 cd "$(dirname "$0")/.." || exit 1
+mkdir -p var/tmp  # gitignored; the log redirects below fail without it
 MAX_POLLS=${MAX_POLLS:-40}
 for i in $(seq 1 "$MAX_POLLS"); do
   # probe via the repo's ABANDONABLE prober: a plain `timeout N python`
