@@ -106,11 +106,34 @@ def _accelerator_expected() -> bool:
     and one probe is enough."""
     req = os.environ.get("JAX_PLATFORMS", "").strip().lower()
     plats = {p.strip() for p in req.split(",") if p.strip()}
-    if plats and not plats <= {"cpu"}:
-        return True
+    if plats:
+        # an explicit cpu-only pin is operator intent: nothing to hunt,
+        # even on a host where an accelerator plugin exists
+        return not plats <= {"cpu"}
     from flyimg_tpu.parallel.mesh import _noncpu_plugin_available
 
     return _noncpu_plugin_available()
+
+
+def _selected_backend_name(timeout_s: float) -> str:
+    """Which backend would a child process actually get? A disposable
+    child applies the env pin and prints ``jax.default_backend()``.
+    Returns '' on failure/timeout. Cheap (seconds) next to a bench child —
+    the hunt uses it to avoid paying for a full CPU measurement when the
+    selection silently degraded (accelerator init failed fast and jax
+    fell back to cpu, which still passes the compute probe)."""
+    code = (
+        f"import sys; sys.path.insert(0, "
+        f"{os.path.dirname(os.path.abspath(__file__))!r});"
+        "from flyimg_tpu.parallel.mesh import ensure_env_platform;"
+        "ensure_env_platform(); import jax; print(jax.default_backend())"
+    )
+    rc, out = _run_abandonable(
+        [sys.executable, "-c", code], timeout_s, capture=True
+    )
+    if rc == 0 and out.strip():
+        return out.strip().splitlines()[-1].strip()
+    return ""
 
 
 def _supervise() -> None:
@@ -151,6 +174,22 @@ def _supervise() -> None:
             break
         if skip_probe or _probe_backend(min(PROBE_TIMEOUT_S, budget)):
             skip_probe = False
+            if hunting and _selected_backend_name(
+                min(PROBE_TIMEOUT_S, budget)
+            ) == "cpu":
+                # probe passed on jax's silent cpu fallback (accelerator
+                # init failing fast): a bench child would only re-measure
+                # CPU — keep hunting instead of paying for it every window
+                print("# selection degraded to cpu; re-hunting",
+                      file=sys.stderr)
+                sleep_for = min(
+                    backoff, max(0.0, total_deadline - time.monotonic()
+                                 - cpu_reserve - min_attempt),
+                )
+                if sleep_for > 0:
+                    time.sleep(sleep_for)
+                backoff = min(backoff * 2, 60.0)
+                continue
             attempt += 1
             budget = total_deadline - time.monotonic() - cpu_reserve
             if budget < min_attempt / 2:

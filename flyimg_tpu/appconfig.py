@@ -41,6 +41,9 @@ SERVER_DEFAULTS: Dict[str, Any] = {
     "tmp_dir": "var/tmp",
     "batch_max_size": 64,
     "batch_deadline_ms": 4.0,
+    # dispatched-but-unread batches in flight (2 = double buffering;
+    # 1 = strict serial launch->read). See runtime/batcher.py.
+    "batch_pipeline_depth": 2,
     "device_mesh": "auto",
 }
 

@@ -76,6 +76,7 @@ def bulk_process(
         batcher = BatchController(
             max_batch=int(params.by_key("batch_max_size", 64)),
             deadline_ms=float(params.by_key("batch_deadline_ms", 4.0)),
+            pipeline_depth=int(params.by_key("batch_pipeline_depth", 2)),
         )
     # host codec work on its own controller so JPEG-decode pool batches
     # don't serialize against device launches (mirrors service/app.py)

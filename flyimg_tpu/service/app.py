@@ -188,6 +188,7 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
         deadline_ms=float(params.by_key("batch_deadline_ms", 4.0)),
         metrics=metrics,
         mesh=mesh,
+        pipeline_depth=int(params.by_key("batch_pipeline_depth", 2)),
     )
     # host codec work gets its OWN controller/thread: JPEG-miss decode
     # batches (native DecodePool) must not serialize with device launches

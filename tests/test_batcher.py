@@ -359,3 +359,47 @@ def test_starving_group_preempts_full_groups():
             assert f.result(timeout=120).shape[1] == 50
     finally:
         ctl.close()
+
+
+def test_pipelined_batches_match_serial():
+    # pipeline_depth 2 (double buffering: dispatch N+1 overlaps N's
+    # readback) must be byte-identical to strict serial depth 1, across
+    # several consecutive batches and mixed shapes
+    serial = BatchController(
+        max_batch=4, deadline_ms=5.0, lone_flush=False, pipeline_depth=1
+    )
+    piped = BatchController(
+        max_batch=4, deadline_ms=5.0, lone_flush=False, pipeline_depth=2
+    )
+    try:
+        jobs = []
+        for i, (w, h) in enumerate(
+            [(600, 400), (620, 410), (580, 390), (600, 400),
+             (300, 200), (310, 210), (300, 200), (290, 190)]
+        ):
+            img = make_test_image(w, h, seed=40 + i)
+            plan = _plan("w_200,h_150,c_1", w, h)
+            jobs.append((img, plan))
+        fs = [serial.submit(img, plan) for img, plan in jobs]
+        fp = [piped.submit(img, plan) for img, plan in jobs]
+        for a, b in zip(fs, fp):
+            np.testing.assert_array_equal(
+                a.result(timeout=180), b.result(timeout=180)
+            )
+    finally:
+        serial.close()
+        piped.close()
+
+
+def test_close_drains_inflight_readbacks():
+    # close() must resolve futures whose batches were dispatched but not
+    # yet read back (the drain pool shuts down with wait=True)
+    ctl = BatchController(max_batch=2, deadline_ms=1.0, pipeline_depth=2)
+    futs = []
+    for i in range(6):
+        img = make_test_image(400, 300, seed=60 + i)
+        futs.append(ctl.submit(img, _plan("w_100", 400, 300)))
+    ctl.close()
+    for f in futs:
+        out = f.result(timeout=60)  # already resolved by close()
+        assert out.shape[1] == 100
