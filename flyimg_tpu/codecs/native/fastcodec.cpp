@@ -65,6 +65,12 @@ static void fc_jpeg_error_exit(j_common_ptr cinfo) {
 
 // Decode a JPEG buffer to RGB. scale_num/8 is the libjpeg DCT scale
 // (pass 8 for full size, 4 for 1/2, 2 for 1/4, 1 for 1/8).
+// CMYK and YCCK (Adobe print-origin) sources decode natively: libjpeg
+// hands back CMYK samples (it converts YCCK->CMYK itself but cannot emit
+// RGB from a CMYK family), and the multiplicative CMYK->RGB fold happens
+// here — the reference feeds such JPEGs through ImageMagick transparently
+// (src/Core/Processor/ImageProcessor.php:68), so the native path must not
+// silently punt them to the slow PIL fallback.
 // Returns malloc'd RGB8 buffer or nullptr; fills width/height.
 uint8_t* fc_jpeg_decode(const uint8_t* data, size_t len, int scale_num,
                         int* width, int* height) {
@@ -72,10 +78,15 @@ uint8_t* fc_jpeg_decode(const uint8_t* data, size_t len, int scale_num,
   fc_jpeg_error_mgr jerr;
   cinfo.err = jpeg_std_error(&jerr.pub);
   jerr.pub.error_exit = fc_jpeg_error_exit;
-  uint8_t* out = nullptr;
+  // volatile: both are modified between setjmp and a potential longjmp;
+  // without it the error path would free indeterminate (register-cached)
+  // values — double-free or leak (C11 7.13.2.1p2)
+  uint8_t* volatile out = nullptr;
+  uint8_t* volatile row4 = nullptr;  // CMYK scanline scratch
   if (setjmp(jerr.setjmp_buffer)) {
     jpeg_destroy_decompress(&cinfo);
     std::free(out);
+    std::free(row4);
     return nullptr;
   }
   jpeg_create_decompress(&cinfo);
@@ -84,7 +95,14 @@ uint8_t* fc_jpeg_decode(const uint8_t* data, size_t len, int scale_num,
     jpeg_destroy_decompress(&cinfo);
     return nullptr;
   }
-  cinfo.out_color_space = JCS_RGB;
+  const bool cmyk = cinfo.jpeg_color_space == JCS_CMYK ||
+                    cinfo.jpeg_color_space == JCS_YCCK;
+  cinfo.out_color_space = cmyk ? JCS_CMYK : JCS_RGB;
+  // Adobe writers store CMYK inverted (byte = 255 - ink); YCCK is defined
+  // over the inverted planes, so treat it as inverted even on the rare
+  // file missing its APP14 marker. Same policy as IM/libjpeg-turbo tools.
+  const bool inverted = cinfo.saw_Adobe_marker ||
+                        cinfo.jpeg_color_space == JCS_YCCK;
   if (scale_num >= 1 && scale_num <= 8) {
     cinfo.scale_num = scale_num;
     cinfo.scale_denom = 8;
@@ -101,10 +119,41 @@ uint8_t* fc_jpeg_decode(const uint8_t* data, size_t len, int scale_num,
     jpeg_destroy_decompress(&cinfo);
     return nullptr;
   }
+  if (cmyk) {
+    row4 = static_cast<uint8_t*>(std::malloc(static_cast<size_t>(w) * 4));
+    if (!row4) {
+      jpeg_abort_decompress(&cinfo);
+      jpeg_destroy_decompress(&cinfo);
+      std::free(out);
+      return nullptr;
+    }
+  }
   while (cinfo.output_scanline < cinfo.output_height) {
     uint8_t* row = out + static_cast<size_t>(cinfo.output_scanline) * stride;
-    jpeg_read_scanlines(&cinfo, &row, 1);
+    if (!cmyk) {
+      jpeg_read_scanlines(&cinfo, &row, 1);
+      continue;
+    }
+    JSAMPROW rows[1] = {row4};
+    jpeg_read_scanlines(&cinfo, rows, 1);
+    // multiplicative fold: R = (255-C)*(255-K)/255 over real ink values;
+    // with Adobe's inverted storage the (255 - s) terms cancel to s*k/255
+    for (int x = 0; x < w; ++x) {
+      const int c = row4[x * 4 + 0], m = row4[x * 4 + 1];
+      const int y = row4[x * 4 + 2], k = row4[x * 4 + 3];
+      if (inverted) {
+        row[x * 3 + 0] = static_cast<uint8_t>(c * k / 255);
+        row[x * 3 + 1] = static_cast<uint8_t>(m * k / 255);
+        row[x * 3 + 2] = static_cast<uint8_t>(y * k / 255);
+      } else {
+        row[x * 3 + 0] = static_cast<uint8_t>((255 - c) * (255 - k) / 255);
+        row[x * 3 + 1] = static_cast<uint8_t>((255 - m) * (255 - k) / 255);
+        row[x * 3 + 2] = static_cast<uint8_t>((255 - y) * (255 - k) / 255);
+      }
+    }
   }
+  std::free(row4);
+  row4 = nullptr;
   jpeg_finish_decompress(&cinfo);
   jpeg_destroy_decompress(&cinfo);
   *width = w;

@@ -37,6 +37,11 @@ def _kernel_fn(method: str, x: jnp.ndarray) -> jnp.ndarray:
         return jnp.where(jnp.abs(x) < 3.0, jnp.sinc(x) * jnp.sinc(x / 3.0), 0.0)
     if method == "triangle":
         return jnp.maximum(0.0, 1.0 - jnp.abs(x))
+    if method == "gaussian":
+        # IM 'Gaussian' (magick/resize.c Gaussian): sigma 1/2, support 1.5
+        # => exp(-2 x^2); the amplitude constant cancels in the row
+        # renormalization below
+        return jnp.where(jnp.abs(x) < 1.5, jnp.exp(-2.0 * x * x), 0.0)
     if method == "cubic":
         # Mitchell-Netravali B=C=1/3 (IM's general-purpose cubic)
         b, c = 1.0 / 3.0, 1.0 / 3.0

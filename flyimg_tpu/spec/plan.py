@@ -42,7 +42,7 @@ FILTER_METHODS = {
     "box": "box",
     "cubic": "cubic",
     "catrom": "cubic",
-    "gaussian": "triangle",  # closest separable approximation we ship
+    "gaussian": "gaussian",  # true taps (ops/resample.py _kernel_fn)
 }
 
 _GEOM_ARG_RE = re.compile(

@@ -652,3 +652,67 @@ def test_metadata_parsers_survive_fuzzed_bytes():
         assert 1 <= tiff_orientation(blob) <= 8
         assert 1 <= m.png_orientation(blob) <= 8
         assert 1 <= m.webp_orientation(blob) <= 8
+
+
+def test_native_cmyk_jpeg_decodes_like_pil():
+    # print-origin (Adobe CMYK) JPEGs must ride the native decoder, not
+    # silently fall to PIL (reference feeds them through IM transparently,
+    # src/Core/Processor/ImageProcessor.php:68). PIL is the independent
+    # oracle for the inverted-CMYK multiplicative fold.
+    import io
+
+    from PIL import Image
+
+    from flyimg_tpu.codecs import decode, native_codec
+
+    if not native_codec.available():
+        pytest.skip("native codec not built")
+    rgb = np.zeros((64, 96, 3), np.uint8)
+    rgb[:, :32] = [255, 0, 0]
+    rgb[:, 32:64] = [0, 255, 0]
+    rgb[:, 64:] = [30, 60, 200]
+    buf = io.BytesIO()
+    Image.fromarray(rgb).convert("CMYK").save(buf, "JPEG", quality=95)
+    data = buf.getvalue()
+
+    out = native_codec.jpeg_decode(data, 8)
+    assert out is not None, "CMYK fell off the native path"
+    oracle = np.asarray(Image.open(io.BytesIO(data)).convert("RGB"))
+    assert out.shape == oracle.shape
+    np.testing.assert_array_equal(out, oracle)
+
+    # PIL's RGB->CMYK always writes K=0, which leaves the fold's k-term at
+    # its identity point — hand-build planes with REAL black ink so the
+    # c*k/255 multiply is exercised. atol 1: native truncates, Pillow's
+    # MULDIV255 rounds.
+    cmyk = np.zeros((32, 48, 4), np.uint8)
+    cmyk[..., 0] = np.linspace(0, 255, 48, dtype=np.uint8)[None, :]
+    cmyk[..., 1] = 80
+    cmyk[..., 2] = 200
+    cmyk[..., 3] = np.linspace(30, 220, 32, dtype=np.uint8)[:, None]
+    buf2 = io.BytesIO()
+    Image.frombytes("CMYK", (48, 32), cmyk.tobytes()).save(
+        buf2, "JPEG", quality=95
+    )
+    data2 = buf2.getvalue()
+    out2 = native_codec.jpeg_decode(data2, 8)
+    assert out2 is not None
+    oracle2 = np.asarray(
+        Image.open(io.BytesIO(data2)).convert("RGB")
+    ).astype(int)
+    assert np.abs(out2.astype(int) - oracle2).max() <= 1
+    # black ink really darkens: bottom rows (high K after inversion math)
+    # must be darker than top rows
+    assert out2[-1].mean() != out2[0].mean()
+
+    # the facade path (what serving calls) returns the same pixels
+    decoded = decode(data)
+    np.testing.assert_array_equal(decoded.rgb, oracle)
+
+    # and the pooled batch decoder (bulk/serving miss batches) agrees
+    pool = native_codec.get_pool()
+    if pool is not None:
+        outs = pool.decode_batch([data, data], 8)
+        for o in outs:
+            assert o is not None
+            np.testing.assert_array_equal(o, oracle)

@@ -80,11 +80,15 @@ def run_stage(name, cmd, timeout_s, results, env=None):
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--out", default="benchmarks/chip_suite_r4.json")
+    ap.add_argument("--round", default="r5", help="suffix for artifacts")
+    ap.add_argument("--out", default=None,
+                    help="default benchmarks/chip_suite_<round>.json")
     ap.add_argument("--skip", action="append", default=[],
-                    choices=["bench", "ops", "bulk", "http"])
+                    choices=["resample", "bench", "ops", "bulk", "http"])
     ap.add_argument("--bulk-src", default="var/bench_images")
     args = ap.parse_args()
+    if args.out is None:
+        args.out = f"benchmarks/chip_suite_{args.round}.json"
 
     # stages run with cwd=REPO; resolve our own paths the same way so the
     # suite behaves identically from any invoking directory
@@ -128,6 +132,18 @@ def main() -> int:
         ], "aborted": "compute probe failed; tunnel down or hung"}))
         return 1
 
+    if "resample" not in args.skip:
+        # the loaded-but-unfired round-4 lever: resample is ~40 of the
+        # flagship's 58.4 us/img — a winning formulation here moves the
+        # headline more than anything else, and the A/B must land EARLY
+        # in the window so the win can be applied and re-benched
+        run_stage(
+            "resample_experiment",
+            [py, "benchmarks/resample_experiment.py", "--out",
+             f"benchmarks/resample_experiment_{args.round}.json"],
+            1800, results,
+        )
+        flush()
     if "bench" not in args.skip:
         # the gate just proved compute works -> skip bench's own probes.
         # Deadline 900s: a COLD compile of the two scan programs through
@@ -142,7 +158,7 @@ def main() -> int:
         run_stage(
             "device_ops",
             [py, "benchmarks/bench_ops.py", "--out",
-             "benchmarks/device_ops_r4.json"],
+             f"benchmarks/device_ops_{args.round}.json"],
             1200, results,
         )
         flush()
@@ -151,7 +167,7 @@ def main() -> int:
             run_stage(
                 "e2e_bulk",
                 [py, "-m", "flyimg_tpu.bulk", "--src", args.bulk_src,
-                 "--out", "var/tmp/bulk_out_r4", "--options",
+                 "--out", f"var/tmp/bulk_out_{args.round}", "--options",
                  "w_300,h_250,c_1,smc_1", "--format", "jpg", "--workers", "16"],
                 1800, results,
             )
