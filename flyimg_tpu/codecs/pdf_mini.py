@@ -26,9 +26,14 @@ remains the preferred backend for full PDF (codecs/pdf.py dispatches).
 
 Object discovery scans the raw bytes for ``N G obj … endobj`` spans
 instead of trusting the xref table — tolerant of the mildly broken xrefs
-real generators emit. Cross-reference *streams* (PDF 1.5 ObjStm) pack
-objects inside compressed streams where the scan cannot see them; those
-documents are refused (they are also far likelier to carry text anyway).
+real generators emit. PDF 1.5 compressed object streams (/Type /ObjStm,
+what post-2005 generators emit alongside cross-reference streams) are
+covered by the same principle: the *containers* are ordinary raw objects
+the scan finds, so their packed objects are unpacked directly — the xref
+stream itself never needs to be trusted (or even parsed; its /Root key is
+found in the raw trailer bytes like any other). FlateDecode PNG
+predictors (/Predictor >= 10), which xref/object streams almost always
+use, are implemented in _png_unfilter.
 """
 
 from __future__ import annotations
@@ -61,6 +66,102 @@ def _bounded_inflate(data: bytes, cap: int = MAX_STREAM_BYTES) -> bytes:
     if d.unconsumed_tail:
         raise PdfRefusal("compressed stream expands past the size ceiling")
     return out
+
+
+# Predictor-filtered streams decode through a per-row pass with a scalar
+# fallback for average/Paeth rows — unlike plain Flate images (a single
+# frombuffer), the work is CPU-bound Python. Bound it the same way the
+# raster ceilings bound allocation: enough for A4-at-600dpi gray or
+# A4-at-300dpi RGB scans, refusal beyond (ghostscript covers the rest).
+MAX_PREDICTOR_BYTES = 48 * 1024 * 1024
+
+
+def _png_unfilter(data: bytes, columns: int, colors: int) -> bytes:
+    """Reverse PNG row filters (predictors 10-15: each row is one filter
+    byte + filtered samples). 8-bit samples only — that covers xref/object
+    streams (W-width integer columns) and the 8bpc images this subset
+    admits. 'none'/'up'/'sub' rows are vectorized; 'average'/'paeth' run a
+    bytearray scalar loop (C-speed indexing), with total input bounded by
+    MAX_PREDICTOR_BYTES so hostile all-Paeth streams cost bounded CPU."""
+    if columns <= 0 or colors <= 0:
+        raise PdfRefusal("bad predictor geometry")
+    if len(data) > MAX_PREDICTOR_BYTES:
+        raise PdfRefusal("predictor stream exceeds the size ceiling")
+    rowlen = columns * colors
+    stride = rowlen + 1
+    nrows, rem = divmod(len(data), stride)
+    if nrows == 0 or rem:
+        raise PdfRefusal("predictor data is not a whole number of rows")
+    bpp = colors
+    out = bytearray(nrows * rowlen)
+    prev = bytes(rowlen)
+    mv = memoryview(data)
+    for r in range(nrows):
+        ft = data[r * stride]
+        row = mv[r * stride + 1 : (r + 1) * stride]
+        if ft == 0:
+            cur = bytes(row)
+        elif ft == 2:  # up
+            cur = (
+                (np.frombuffer(row, np.uint8).astype(np.int16)
+                 + np.frombuffer(prev, np.uint8)) & 255
+            ).astype(np.uint8).tobytes()
+        elif ft == 1:  # sub: running sum per byte lane, mod 256
+            arr = np.frombuffer(row, np.uint8).reshape(columns, bpp)
+            cur = (np.cumsum(arr.astype(np.int64), axis=0) & 255).astype(
+                np.uint8
+            ).tobytes()
+        elif ft in (3, 4):
+            rb = bytes(row)
+            buf = bytearray(rowlen)
+            for i in range(rowlen):
+                left = buf[i - bpp] if i >= bpp else 0
+                up = prev[i]
+                if ft == 3:
+                    p = (left + up) >> 1
+                else:
+                    ul = prev[i - bpp] if i >= bpp else 0
+                    pa = up - ul
+                    if pa < 0:
+                        pa = -pa
+                    pb = left - ul
+                    if pb < 0:
+                        pb = -pb
+                    pc = left + up - 2 * ul
+                    if pc < 0:
+                        pc = -pc
+                    if pa <= pb and pa <= pc:
+                        p = left
+                    elif pb <= pc:
+                        p = up
+                    else:
+                        p = ul
+                buf[i] = (rb[i] + p) & 255
+            cur = bytes(buf)
+        else:
+            raise PdfRefusal(f"unknown PNG row filter {int(ft)}")
+        out[r * rowlen : (r + 1) * rowlen] = cur
+        prev = cur
+    return bytes(out)
+
+
+def _apply_decode_parms(data: bytes, parms, ncomp_default: int = 1) -> bytes:
+    """Apply a fully-RESOLVED FlateDecode /DecodeParms dict to inflated
+    bytes (callers resolve indirect refs/arrays via MiniPdf._parms_for)."""
+    if parms is None:
+        return data
+    if not isinstance(parms, dict):
+        raise PdfRefusal(f"unsupported /DecodeParms {parms!r}")
+    pred = int(parms.get("Predictor", 1) or 1)
+    if pred == 1:
+        return data
+    if pred == 2:
+        raise PdfRefusal("TIFF predictor 2 unsupported")
+    if int(parms.get("BitsPerComponent", 8) or 8) != 8:
+        raise PdfRefusal("predictor BitsPerComponent != 8 unsupported")
+    columns = int(parms.get("Columns", 1) or 1)
+    colors = int(parms.get("Colors", ncomp_default) or ncomp_default)
+    return _png_unfilter(data, columns, colors)
 
 
 # ---------------------------------------------------------------- tokenizer
@@ -207,7 +308,12 @@ class MiniPdf:
             raise PdfRefusal("not a PDF (missing %PDF- header)")
         self.data = data
         self.objects: dict[int, tuple[object, bytes | None]] = {}
+        # byte offset each object number was defined at (ObjStm-packed
+        # objects inherit their container's offset) — incremental-update
+        # precedence is "largest offset wins" across both layers
+        self._origin: dict[int, int] = {}
         self._scan_objects()
+        self._unpack_objstms()
         self.pages = self._collect_pages()
 
     # -- object layer
@@ -270,9 +376,59 @@ class MiniPdf:
                 pos = end + len(b"endstream")
             # later definitions (incremental updates) win: keep highest offset
             self.objects[num] = (obj, stream)
+            self._origin[num] = m.start()
         if not self.objects:
-            raise PdfRefusal("no parseable objects (cross-reference streams / "
-                             "object streams are outside the image-only subset)")
+            raise PdfRefusal("no parseable objects")
+
+    def _unpack_objstms(self) -> None:
+        """Unpack PDF 1.5 compressed object streams (/Type /ObjStm).
+
+        The containers are ordinary raw ``N G obj`` stream objects the
+        scan already found; their payload is Flate(+predictor) data laid
+        out as N (objnum, offset) integer pairs followed at /First by the
+        serialized objects. Packed objects carry no streams (spec rule),
+        so (obj, None) entries suffice. Precedence merges with the raw
+        layer by byte offset: a packed object loses to a raw redefinition
+        that appears LATER in the file (incremental update) and wins over
+        an earlier one."""
+        for cnum, (cobj, craw) in list(self.objects.items()):
+            if not (
+                isinstance(cobj, dict)
+                and cobj.get("Type") == "ObjStm"
+                and craw is not None
+            ):
+                continue
+            try:
+                data = self._decode_stream_data(cobj, craw)
+                n = int(self.resolve(cobj.get("N")))
+                first = int(self.resolve(cobj.get("First")))
+                if n <= 0 or n > 100_000 or first < 0 or first > len(data):
+                    raise PdfRefusal("bad ObjStm header")
+                head = _Lexer(data[:first])
+                pairs = []
+                for _ in range(n):
+                    onum = head.read_object()
+                    off = head.read_object()
+                    if not isinstance(onum, int) or not isinstance(off, int):
+                        raise PdfRefusal("non-integer ObjStm index entry")
+                    pairs.append((onum, off))
+            except Exception:
+                # one broken container (bad flate, garbage header, short
+                # payload) must not take down the document — anything that
+                # needed its objects surfaces as a dangling-ref refusal
+                # later
+                continue
+            origin = self._origin.get(cnum, 0)
+            for onum, off in pairs:
+                if off < 0 or first + off >= len(data):
+                    continue  # offsets are relative to /First
+                try:
+                    packed = _Lexer(data, first + off).read_object()
+                except PdfRefusal:
+                    continue
+                if self._origin.get(onum, -1) <= origin:
+                    self.objects[onum] = (packed, None)
+                    self._origin[onum] = origin
 
     def resolve(self, v):
         seen = 0
@@ -295,20 +451,38 @@ class MiniPdf:
         return entry[0], entry[1]
 
     def decoded_stream(self, ref) -> bytes:
-        """Stream bytes with Flate applied (for content streams)."""
+        """Stream bytes with Flate(+predictor) applied (content streams)."""
         obj, raw = self.stream_for(ref)
+        return self._decode_stream_data(obj, raw)
+
+    def _parms_for(self, parms, index: int):
+        """Resolve one filter's /DecodeParms to a plain dict (or None):
+        handles an indirect parms object, the array-parallel-to-Filter
+        form, and indirect values inside the dict."""
+        parms = self.resolve(parms)
+        if isinstance(parms, list):
+            parms = (
+                self.resolve(parms[index]) if index < len(parms) else None
+            )
+        if parms is None:
+            return None
+        if not isinstance(parms, dict):
+            raise PdfRefusal(f"unsupported /DecodeParms {parms!r}")
+        return {k: self.resolve(v) for k, v in parms.items()}
+
+    def _decode_stream_data(self, obj: dict, raw: bytes) -> bytes:
         filters = self.resolve(obj.get("Filter"))
         if filters is None:
             return raw
         if isinstance(filters, str):
             filters = [filters]
+        parms = obj.get("DecodeParms")
         out = raw
-        for f in filters:
+        for i, f in enumerate(filters):
             f = self.resolve(f)
             if f == "FlateDecode":
-                if self.resolve(obj.get("DecodeParms")) not in (None,):
-                    raise PdfRefusal("FlateDecode predictors unsupported")
                 out = _bounded_inflate(out)
+                out = _apply_decode_parms(out, self._parms_for(parms, i))
             else:
                 raise PdfRefusal(f"content-stream filter {f!r} unsupported")
         return out
@@ -401,13 +575,20 @@ class MiniPdf:
                 )
             px = _decode_jpeg(raw)
         elif filters in ([], ["FlateDecode"]):
-            if obj.get("DecodeParms") is not None:
-                raise PdfRefusal("Flate predictors unsupported for images")
             if bpc != 8:
                 raise PdfRefusal(f"BitsPerComponent {bpc} unsupported")
             ncomp = _ncomponents(obj.get("ColorSpace"))
             need = w * h * ncomp
-            data = _bounded_inflate(raw, need + 64) if filters else raw
+            if filters:
+                # predictor rows add one filter byte per row to the
+                # inflated size; the ceiling accounts for it
+                data = _bounded_inflate(raw, need + h + 64)
+                data = _apply_decode_parms(
+                    data, self._parms_for(obj.get("DecodeParms"), 0),
+                    ncomp_default=ncomp,
+                )
+            else:
+                data = raw
             if len(data) < need:
                 raise PdfRefusal("image stream shorter than declared size")
             px = np.frombuffer(data[:need], np.uint8).reshape(h, w, ncomp)

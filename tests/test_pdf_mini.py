@@ -417,3 +417,192 @@ def test_zip_bomb_image_stream_refused():
     objs[4] = _stream(bomb, head)
     with pytest.raises(PdfRefusal):
         MiniPdf(_pdf(objs)).rasterize(1, 72)
+
+
+# ---- PDF 1.5: compressed object streams + predictors ------------------
+
+
+def _build_objstm(packed: dict[int, bytes]) -> bytes:
+    """Assemble a /Type /ObjStm container from {objnum: serialized body}."""
+    head_parts, body_parts = [], []
+    off = 0
+    for num, body in packed.items():
+        head_parts.append(b"%d %d" % (num, off))
+        body_parts.append(body)
+        off += len(body) + 1
+    header = b" ".join(head_parts) + b"\n"
+    payload = header + b"\n".join(body_parts) + b"\n"
+    comp = zlib.compress(payload)
+    return _stream(
+        comp,
+        b"/Type /ObjStm /N %d /First %d /Filter /FlateDecode "
+        % (len(packed), len(header)),
+    )
+
+
+def _pdf15(objects: dict[int, bytes]) -> bytes:
+    """PDF 1.5 shape: NO classic trailer dict — the /Root key lives only
+    in the cross-reference stream object's dictionary, like modern
+    generators emit."""
+    out = [b"%PDF-1.5\n"]
+    for num, body in objects.items():
+        out.append(b"%d 0 obj" % num + body + b"endobj\n")
+    xref = _stream(
+        zlib.compress(b"\x00" * 24),
+        b"/Type /XRef /Size 9 /W [1 2 1] /Root 1 0 R /Filter /FlateDecode ",
+    )
+    out.append(b"8 0 obj" + xref + b"endobj\nstartxref\n9\n%%EOF\n")
+    return b"".join(out)
+
+
+_PACKED_TREE = {
+    1: b"<< /Type /Catalog /Pages 2 0 R >>",
+    2: b"<< /Type /Pages /Count 1 /Kids [3 0 R] >>",
+    3: (
+        b"<< /Type /Page /Parent 2 0 R /MediaBox [0 0 20 10] "
+        b"/Resources << /XObject << /im 4 0 R >> >> /Contents 5 0 R >>"
+    ),
+}
+
+
+def test_objstm_packed_page_tree_rasterizes():
+    # catalog + pages + page packed in an ObjStm; image and content are
+    # raw stream objects (spec: streams cannot live inside ObjStm); /Root
+    # only in the xref stream dict — the modern post-2005 layout end to end
+    objs = {
+        6: _build_objstm(_PACKED_TREE),
+        4: _flate_image(_solid(2, 2, (10, 200, 30))),
+        5: _stream(b"q 20 0 0 10 0 0 cm /im Do Q"),
+    }
+    arr = MiniPdf(_pdf15(objs)).rasterize(1, 72)
+    assert arr.shape == (10, 20, 3)
+    assert (arr == [10, 200, 30]).all()
+
+
+def test_objstm_precedence_by_file_offset():
+    # a raw redefinition AFTER the container wins; one BEFORE loses
+    red = _flate_image(_solid(2, 2, (200, 0, 0)))
+    packed = dict(_PACKED_TREE)
+    packed[9] = b"<< /Marker /FromObjStm >>"
+    # raw object 9 BEFORE the ObjStm: packed definition supersedes it
+    objs = {
+        9: b"<< /Marker /RawEarly >>",
+        6: _build_objstm(packed),
+        4: red,
+        5: _stream(b"q 20 0 0 10 0 0 cm /im Do Q"),
+    }
+    doc = MiniPdf(_pdf15(objs))
+    assert doc.objects[9][0]["Marker"] == "FromObjStm"
+    # raw object AFTER the ObjStm: raw wins (incremental update)
+    data = _pdf15(objs)
+    data = data.replace(
+        b"startxref",
+        b"9 0 obj<< /Marker /RawLate >>endobj\nstartxref",
+    )
+    assert MiniPdf(data).objects[9][0]["Marker"] == "RawLate"
+
+
+def test_broken_objstm_container_skipped_not_fatal():
+    # corrupt flate payload in one container: the document still refuses
+    # cleanly at the page layer (dangling refs), not with a zlib error
+    objs = {
+        6: _stream(b"garbage-not-flate",
+                   b"/Type /ObjStm /N 3 /First 10 /Filter /FlateDecode "),
+        4: _flate_image(_solid(2, 2, (1, 2, 3))),
+        5: _stream(b"q 20 0 0 10 0 0 cm /im Do Q"),
+    }
+    with pytest.raises(PdfRefusal):
+        MiniPdf(_pdf15(objs))
+
+
+def _png_filter_forward(px2d: np.ndarray, ftype: int, bpp: int) -> bytes:
+    """Independent forward PNG filter (RFC 2083) for oracle data."""
+    rows, rowlen = px2d.shape
+    out = bytearray()
+    prev = np.zeros(rowlen, np.int32)
+    for r in range(rows):
+        cur = px2d[r].astype(np.int32)
+        left = np.concatenate([np.zeros(bpp, np.int32), cur[:-bpp]])
+        ul = np.concatenate([np.zeros(bpp, np.int32), prev[:-bpp]])
+        if ftype == 0:
+            enc = cur
+        elif ftype == 1:
+            enc = (cur - left) & 255
+        elif ftype == 2:
+            enc = (cur - prev) & 255
+        elif ftype == 3:
+            enc = (cur - ((left + prev) >> 1)) & 255
+        else:
+            pa = np.abs(prev - ul)
+            pb = np.abs(left - ul)
+            pc = np.abs(left + prev - 2 * ul)
+            pred = np.where(
+                (pa <= pb) & (pa <= pc), left, np.where(pb <= pc, prev, ul)
+            )
+            enc = (cur - pred) & 255
+        out.append(ftype)
+        out.extend(enc.astype(np.uint8).tobytes())
+        prev = cur
+    return bytes(out)
+
+
+@pytest.mark.parametrize("ftype", [0, 1, 2, 3, 4])
+def test_png_unfilter_recovers_every_filter_type(ftype):
+    from flyimg_tpu.codecs.pdf_mini import _png_unfilter
+
+    rng = np.random.default_rng(7)
+    px = rng.integers(0, 256, (6, 5 * 3), dtype=np.uint8)
+    enc = _png_filter_forward(px, ftype, bpp=3)
+    dec = _png_unfilter(enc, columns=5, colors=3)
+    np.testing.assert_array_equal(
+        np.frombuffer(dec, np.uint8).reshape(6, 15), px
+    )
+
+
+def test_flate_image_with_png_predictor_renders():
+    # predictor 12 (PNG up) on the image stream itself — common for
+    # PNG-repacked scans; previously a refusal class
+    px = _solid(4, 3, (90, 140, 10))
+    filtered = _png_filter_forward(
+        px.reshape(3, 12), 2, bpp=3
+    )
+    img = _stream(
+        zlib.compress(filtered),
+        b"/Type /XObject /Subtype /Image /Width 4 /Height 3 "
+        b"/Filter /FlateDecode /BitsPerComponent 8 /ColorSpace /DeviceRGB "
+        b"/DecodeParms << /Predictor 12 /Colors 3 /Columns 4 >> ",
+    )
+    objs = _page_objs(b"q 20 0 0 10 0 0 cm /im Do Q")
+    objs[4] = img
+    arr = MiniPdf(_pdf(objs)).rasterize(1, 72)
+    assert (arr == [90, 140, 10]).all()
+
+
+def test_decodeparms_indirect_and_array_forms_resolve():
+    # legal spellings: /DecodeParms 7 0 R, /DecodeParms [<<...>>], and
+    # indirect VALUES inside the dict — all must reach the predictor
+    px = _solid(4, 3, (90, 140, 10))
+    filtered = _png_filter_forward(px.reshape(3, 12), 2, bpp=3)
+    comp = zlib.compress(filtered)
+    head = (
+        b"/Type /XObject /Subtype /Image /Width 4 /Height 3 "
+        b"/Filter /FlateDecode /BitsPerComponent 8 /ColorSpace /DeviceRGB "
+    )
+    for parms in (
+        b"/DecodeParms 7 0 R ",
+        b"/DecodeParms [<< /Predictor 12 /Colors 3 /Columns 4 >>] ",
+        b"/DecodeParms << /Predictor 12 /Colors 3 /Columns 9 0 R >> ",
+    ):
+        objs = _page_objs(b"q 20 0 0 10 0 0 cm /im Do Q")
+        objs[4] = _stream(comp, head + parms)
+        objs[7] = b"<< /Predictor 12 /Colors 3 /Columns 4 >>"
+        objs[9] = b" 4 "
+        arr = MiniPdf(_pdf(objs)).rasterize(1, 72)
+        assert (arr == [90, 140, 10]).all(), parms
+
+
+def test_oversized_predictor_stream_refused():
+    from flyimg_tpu.codecs.pdf_mini import MAX_PREDICTOR_BYTES, _png_unfilter
+
+    with pytest.raises(PdfRefusal):
+        _png_unfilter(b"\x00" * (MAX_PREDICTOR_BYTES + 11), 10, 1)
