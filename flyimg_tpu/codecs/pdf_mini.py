@@ -424,7 +424,11 @@ class MiniPdf:
                     continue  # offsets are relative to /First
                 try:
                     packed = _Lexer(data, first + off).read_object()
-                except PdfRefusal:
+                except Exception:
+                    # same containment as the container level: the lexer
+                    # can also raise ValueError (bad hex, missing '>'),
+                    # and one malformed packed object — possibly unused —
+                    # must not refuse the whole document
                     continue
                 if self._origin.get(onum, -1) <= origin:
                     self.objects[onum] = (packed, None)

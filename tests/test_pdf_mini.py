@@ -606,3 +606,18 @@ def test_oversized_predictor_stream_refused():
 
     with pytest.raises(PdfRefusal):
         _png_unfilter(b"\x00" * (MAX_PREDICTOR_BYTES + 11), 10, 1)
+
+
+def test_malformed_packed_object_skipped_not_fatal():
+    # a packed object whose body the lexer cannot parse (unterminated hex
+    # string raises ValueError, not PdfRefusal) must be skipped; the rest
+    # of the container still unpacks and the document renders
+    packed = dict(_PACKED_TREE)
+    packed[9] = b"<deadbe"  # unterminated hex string
+    objs = {
+        6: _build_objstm(packed),
+        4: _flate_image(_solid(2, 2, (10, 200, 30))),
+        5: _stream(b"q 20 0 0 10 0 0 cm /im Do Q"),
+    }
+    arr = MiniPdf(_pdf15(objs)).rasterize(1, 72)
+    assert (arr == [10, 200, 30]).all()

@@ -52,15 +52,18 @@ def _make_source(path: str, seed: int = 42) -> str:
     return path
 
 
-async def _rated_run(
-    client: httpx.AsyncClient, url: str, rate: float, duration: float
-):
-    """Fire GETs at a fixed rate (vegeta-style open-loop), gather latencies."""
+async def _rated_run(client: httpx.AsyncClient, urls: list, rate: float):
+    """Fire one GET per URL on a fixed-rate schedule (vegeta-style
+    open-loop), regardless of completions; gather latencies. Cache-hit
+    scenarios pass the same URL repeated; the rated-miss sweep passes
+    distinct uncached keys — a rate the host can't sustain shows up as
+    p99 growing with elapsed time (queueing), which is the knee the
+    sweep looks for."""
     latencies: list = []
     failures = 0
     tasks = []
 
-    async def one():
+    async def one(url):
         nonlocal failures
         t0 = time.perf_counter()
         try:
@@ -74,13 +77,12 @@ async def _rated_run(
             failures += 1
 
     start = time.perf_counter()
-    n = int(rate * duration)
-    for i in range(n):
+    for i, url in enumerate(urls):
         target = start + i / rate
         delay = target - time.perf_counter()
         if delay > 0:
             await asyncio.sleep(delay)
-        tasks.append(asyncio.ensure_future(one()))
+        tasks.append(asyncio.ensure_future(one(url)))
     await asyncio.gather(*tasks)
     elapsed = time.perf_counter() - start
     return latencies, failures, elapsed
@@ -152,7 +154,8 @@ async def _miss_run(
 def _report(name: str, mode: str, lat, failures: int, elapsed: float):
     if not lat:
         print(f"{name:8s} {mode:6s}  ALL {failures} REQUESTS FAILED")
-        return
+        return {"scenario": name, "mode": mode, "requests": failures,
+                "success_rate": 0.0}
     arr = np.asarray(lat) * 1000.0
     row = {
         "scenario": name,
@@ -176,6 +179,7 @@ def _report(name: str, mode: str, lat, failures: int, elapsed: float):
         f"ok {row['success_rate'] * 100:.1f}%"
     )
     print(json.dumps(row))
+    return row
 
 
 def _free_port() -> int:
@@ -201,6 +205,20 @@ async def main() -> int:
         help="throwaway miss requests first, so the batch-size ladder's "
              "programs are compiled before measurement",
     )
+    ap.add_argument(
+        "--miss-rates", default=None,
+        help="comma list of req/s for a RATED miss sweep (each rate runs "
+             "--duration s of distinct-key misses; the p99-vs-rate curve "
+             "locates the miss-path knee)")
+    ap.add_argument(
+        "--miss-out", default=None,
+        help="write the rated-miss sweep rows to this JSON artifact")
+    ap.add_argument(
+        "--fresh-storage", action="store_true",
+        help="spawn the service with a throwaway output-cache dir. "
+             "REQUIRED for honest miss measurements: a persistent "
+             "web/uploads populated by earlier runs silently turns "
+             "'misses' into 4 ms cache hits (found the hard way, round 5)")
     ap.add_argument("--spawn", action="store_true", help="start the service here")
     ap.add_argument("--source", default="var/tmp/bench-source.jpg")
     args = ap.parse_args()
@@ -210,13 +228,25 @@ async def main() -> int:
         return 2
 
     proc = None
+    store = None
     base = args.base
     if base is None:
         port = _free_port()
         base = f"http://127.0.0.1:{port}"
+        spawn_cmd = [
+            sys.executable, "-m", "flyimg_tpu.service.app", "serve",
+            "--port", str(port),
+        ]
+        if args.fresh_storage:
+            import tempfile
+
+            store = tempfile.mkdtemp(prefix="flyimg-bench-store-")
+            params_path = os.path.join(store, "params.yml")
+            with open(params_path, "w") as fh:
+                fh.write(f"upload_dir: {os.path.join(store, 'out')}\n")
+            spawn_cmd += ["--params", params_path]
         proc = subprocess.Popen(
-            [sys.executable, "-m", "flyimg_tpu.service.app", "serve",
-             "--port", str(port)],
+            spawn_cmd,
             stdout=subprocess.DEVNULL,
             stderr=subprocess.DEVNULL,
         )
@@ -257,7 +287,7 @@ async def main() -> int:
                     rc = 1
                     continue
                 lat, fails, elapsed = await _rated_run(
-                    client, url, args.rate, args.duration
+                    client, [url] * int(args.rate * args.duration), args.rate
                 )
                 _report(name, "rated", lat, fails, elapsed)
                 if args.burst:
@@ -288,6 +318,87 @@ async def main() -> int:
                     client, urls[args.miss_warm:], args.conc
                 )
                 _report("miss", "burst", lat, fails, elapsed)
+
+            if args.miss_rates:
+                rates = [float(r) for r in args.miss_rates.split(",")]
+                src_dir = os.path.dirname(args.source) or "."
+                # a reusable pool of distinct sources; distinct CACHE KEYS
+                # come from source x quality so the pool stays modest while
+                # every request is still an uncoalescible miss
+                pool = [
+                    _make_source(
+                        os.path.join(src_dir, f"bench-miss-{i}.jpg"),
+                        seed=1000 + i,
+                    )
+                    for i in range(320)
+                ]
+                # q_90 canonicalizes to the SAME cache key as no-q (the
+                # default quality), so start below it or the first leg's
+                # "misses" can hit outputs cached by a plain-options run
+                key_seq = iter(
+                    (s, q) for q in range(89, 1, -1) for s in pool
+                )
+                available = len(pool) * len(range(89, 1, -1))
+                needed = 16 + 2 * sum(
+                    max(int(r * args.duration), 1) for r in rates
+                )
+                if needed > available:
+                    print(
+                        f"miss sweep needs {needed} distinct keys, only "
+                        f"{available} available — lower the rates/duration",
+                        file=sys.stderr,
+                    )
+                    return 1
+
+                def next_urls(options, n):
+                    out = []
+                    for _ in range(n):
+                        s, q = next(key_seq)
+                        out.append(f"{base}/upload/{options},q_{q}/{s}")
+                    return out
+
+                # warm the batch ladder + program cache once, off-record
+                await _miss_run(
+                    client, next_urls(SCENARIOS[0][1], 16), 8
+                )
+                sweep = []
+                for vname, vopts in (
+                    ("moz_1", SCENARIOS[0][1]),
+                    ("moz_0", SCENARIOS[0][1] + ",moz_0"),
+                ):
+                    for rate in rates:
+                        n = max(int(rate * args.duration), 1)
+                        lat, fails, elapsed = await _rated_run(
+                            client, next_urls(vopts, n), rate
+                        )
+                        row = _report(
+                            f"miss-{vname}", f"rated@{rate:g}", lat, fails,
+                            elapsed,
+                        )
+                        row["offered_rate_rps"] = rate
+                        row["options"] = vopts
+                        sweep.append(row)
+                if args.miss_out:
+                    with open(args.miss_out, "w") as fh:
+                        json.dump({
+                            "what": (
+                                "RATED (open-loop) cache-MISS latency vs "
+                                "offered rate; every request is a distinct "
+                                "uncoalescible key through the full "
+                                "fetch/decode/device/encode miss pipeline"
+                            ),
+                            "method": (
+                                f"{args.duration}s per rate per encoder "
+                                "variant; vegeta-style fixed schedule; "
+                                "service and client share this host"
+                            ),
+                            "backend": os.environ.get(
+                                "JAX_PLATFORMS", "default"
+                            ),
+                            "rows": sweep,
+                        }, fh, indent=1)
+                        fh.write("\n")
+                    print(f"wrote {args.miss_out}")
     finally:
         if proc is not None:
             proc.send_signal(signal.SIGTERM)
@@ -295,6 +406,11 @@ async def main() -> int:
                 proc.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 proc.kill()
+        if store is not None:
+            # the throwaway cache holds thousands of miss outputs per sweep
+            import shutil
+
+            shutil.rmtree(store, ignore_errors=True)
     return rc
 
 
