@@ -27,6 +27,7 @@ IM's edge virtual-pixel handling, and it makes bucket padding invisible
 
 from __future__ import annotations
 
+import os
 from typing import Tuple
 
 import jax
@@ -119,9 +120,46 @@ def resample_image(
     wx = resample_matrix(
         in_w, out_w, span_x[0], span_x[1], out_true_hw[1], in_true_hw[1], method
     )
+    if RESAMPLE_FORM == "fold2d_bf16":
+        return _apply_fold2d_bf16(image, wy, wx, out_h, out_w)
     # DEFAULT precision = bf16 multiplies with f32 accumulation on TPU: 2.3x
     # the throughput of the f32 path, worst-case error well under one uint8
     # level for 8-bit imagery (bf16 has 8 mantissa bits). On CPU this is
     # plain f32, so conformance tests are unaffected.
     tmp = jnp.einsum("oh,hwc->owc", wy, image, precision=jax.lax.Precision.DEFAULT)
     return jnp.einsum("ow,hwc->hoc", wx, tmp, precision=jax.lax.Precision.DEFAULT)
+
+
+#: Weight-application formulation. 'einsum' is the shipped two-einsum
+#: form over [h, w, c]; 'fold2d_bf16' folds channels into plain 2D
+#: matmuls with explicit bf16 operands + f32 accumulation — the
+#: benchmarks/resample_experiment.py candidate that avoids XLA
+#: padding/permuting C=3 on the (8,128) tile minor dim. Flip the default
+#: only on a measured >=10%-within-one-uint8-level on-chip win; the env
+#: var exists so the A/B can run the SERVING code path.
+RESAMPLE_FORM = os.environ.get("FLYIMG_RESAMPLE_FORM", "einsum")
+
+
+def _apply_fold2d_bf16(
+    image: jnp.ndarray, wy: jnp.ndarray, wx: jnp.ndarray,
+    out_h: int, out_w: int,
+) -> jnp.ndarray:
+    """H-pass as [oh,h]@[h,w*c], W-pass as [oh*c,w]@[w,ow]: both clean 2D
+    MXU matmuls. bf16 operands halve the HBM traffic of image+intermediate;
+    accumulation stays f32 (preferred_element_type), so the result differs
+    from the einsum form by well under one uint8 level on 8-bit imagery."""
+    h, w = image.shape[0], image.shape[1]
+    c = image.shape[2]
+    imgb = image.astype(jnp.bfloat16)
+    tmp = jax.lax.dot_general(
+        wy.astype(jnp.bfloat16), imgb.reshape(h, w * c),
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+    ).reshape(out_h, w, c)
+    t2 = jnp.transpose(tmp.astype(jnp.bfloat16), (0, 2, 1)).reshape(
+        out_h * c, w
+    )
+    out = jax.lax.dot_general(
+        t2, wx.astype(jnp.bfloat16).T,
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+    ).reshape(out_h, c, out_w)
+    return jnp.transpose(out, (0, 2, 1))

@@ -31,7 +31,12 @@ from flyimg_tpu.service.input_source import load_source
 from flyimg_tpu.service.output_image import OutputSpec, resolve_output
 from flyimg_tpu.service.security import SecurityHandler
 from flyimg_tpu.spec.options import OptionsBag
-from flyimg_tpu.spec.plan import TransformPlan, build_plan, decode_target_hint
+from flyimg_tpu.spec.plan import (
+    TransformPlan,
+    build_plan,
+    decode_target_hint,
+    parse_colorspace,
+)
 from flyimg_tpu.storage.base import Storage
 
 
@@ -437,6 +442,13 @@ class ImageHandler:
         quality = options.int_option("quality", 90) or 90
         mozjpeg = str(options.get_option("mozjpeg")) == "1"
         sampling_factor = str(options.get_option("sampling-factor") or "1x1")
+        if parse_colorspace(options) == "cmyk":
+            # CMYK is an ENCODE-side space: device pixels stay RGB and the
+            # container stores CMYK samples (reference: IM converts and
+            # writes CMYK JPEGs transparently, ImageProcessor.php:88).
+            # Container validity was checked before any decode/device work
+            # (_process_new).
+            return _encode_cmyk_jpeg(frame, spec, quality, mozjpeg)
         if (
             self.codec_batcher is not None
             and spec.extension == "jpg"
@@ -506,6 +518,20 @@ class ImageHandler:
         t = time.perf_counter()
 
         is_animated_gif_out = spec.is_gif
+        # clsp_CMYK can only be stored in a JPEG container: refuse HERE,
+        # before decode and device work — and before the animation branch,
+        # whose encoder would otherwise silently serve RGB GIF bytes under
+        # a URL claiming CMYK
+        if (
+            parse_colorspace(options) == "cmyk"
+            and spec.extension not in ("jpg", "jpeg")
+        ):
+            from flyimg_tpu.exceptions import InvalidArgumentException
+
+            raise InvalidArgumentException(
+                "clsp_CMYK requires a JPEG output container (o_jpg); "
+                f"{spec.extension!r} cannot store CMYK samples"
+            )
         # decode target hint for JPEG DCT prescale (scale-aware)
         hint = decode_target_hint(options)
 
@@ -700,6 +726,11 @@ class ImageHandler:
             from flyimg_tpu.codecs import metadata as meta_mod
 
             meta = meta_mod.collect(data, decoded.mime)
+            if meta and parse_colorspace(options) == "cmyk":
+                # the source's RGB ICC profile must not be grafted onto
+                # CMYK samples — color-managed decoders would apply an
+                # RGB profile to 4-component data (EXIF/XMP still carry)
+                meta.icc = None
             if meta:
                 content = meta_mod.inject(content, spec.extension, meta)
         timings["encode"] = time.perf_counter() - t
@@ -759,6 +790,40 @@ def _decode_all_frames(data: bytes) -> _Animation:
         durations=durations,
         loop=loop,
     )
+
+
+def _encode_cmyk_jpeg(frame: np.ndarray, spec, quality: int,
+                      optimize: bool) -> bytes:
+    """clsp_CMYK output: IM's sRGB->CMYK black-extraction conversion
+    (MagickCore colorspace.c sRGBToCMYK: K = min(C,M,Y), channels rescaled
+    by 1-K) stored in a CMYK JPEG with the Adobe APP14 convention — the
+    multiplicative inverse recovers the sRGB values exactly up to
+    quantization (pinned in tests). JPEG is the only supported container
+    for CMYK samples (PNG/WebP/GIF define none), matching what IM can
+    actually store."""
+    import io
+
+    from PIL import Image
+
+    from flyimg_tpu.exceptions import InvalidArgumentException
+
+    if spec.extension not in ("jpg", "jpeg"):
+        raise InvalidArgumentException(
+            "clsp_CMYK requires a JPEG output container (o_jpg); "
+            f"{spec.extension!r} cannot store CMYK samples"
+        )
+    f = frame.astype(np.float32) / 255.0
+    cmy = 1.0 - f
+    k = cmy.min(axis=2, keepdims=True)
+    denom = np.where(k < 1.0, 1.0 - k, 1.0)
+    cmyk = np.concatenate([(cmy - k) / denom, k], axis=2)
+    arr = np.clip(cmyk * 255.0 + 0.5, 0, 255).astype(np.uint8)
+    im = Image.frombytes(
+        "CMYK", (frame.shape[1], frame.shape[0]), arr.tobytes()
+    )
+    buf = io.BytesIO()
+    im.save(buf, "JPEG", quality=int(quality), optimize=bool(optimize))
+    return buf.getvalue()
 
 
 def _encode_gif_animation(frames, alphas, durations, loop) -> bytes:

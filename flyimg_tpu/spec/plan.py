@@ -45,6 +45,37 @@ FILTER_METHODS = {
     "gaussian": "gaussian",  # true taps (ops/resample.py _kernel_fn)
 }
 
+def parse_colorspace(options: "OptionsBag"):
+    """THE clsp_ parser (build_plan and the handler's container check
+    both consume it — two copies would drift). Normalizes IM's spelling
+    variants (LinearGray / linear-gray / Linear Gray all name one
+    colorspace in IM's MagickCore option table) and returns None (native
+    sRGB), 'gray', 'gray601', or 'cmyk'.
+
+    'cmyk': device pixels stay RGB; the ENCODER stores CMYK samples (IM's
+    sRGB->CMYK black-extraction formula, JPEG container only — the
+    handler validates the container before any decode/device work).
+    Every other IM colorspace (lab, hsl, ...) would change the stored
+    sample meaning; refusing loudly beats a silent no-op that serves sRGB
+    bytes while the URL claims otherwise (reference forwards the value to
+    convert, ImageProcessor.php:88)."""
+    raw = re.sub(
+        r"[^a-z0-9]", "", str(options.get_option("colorspace") or "").lower()
+    )
+    if raw in ("gray", "grey", "grayscale", "lineargray", "rec709luma"):
+        return "gray"
+    if raw == "rec601luma":
+        return "gray601"  # SD-video luma weights, distinct from 709
+    if raw == "cmyk":
+        return "cmyk"
+    if raw in ("", "none", "srgb", "rgb"):
+        return None
+    raise InvalidArgumentException(
+        f"unsupported colorspace {raw!r} (supported: gray/grey/grayscale/"
+        "lineargray/rec601luma/rec709luma, cmyk, srgb, rgb)"
+    )
+
+
 _GEOM_ARG_RE = re.compile(
     r"^(?P<radius>\d*\.?\d+)?(?:x(?P<sigma>\d*\.?\d+))?"
     r"(?:\+(?P<gain>\d*\.?\d+))?(?:\+(?P<threshold>\d*\.?\d+))?$"
@@ -311,29 +342,7 @@ def build_plan(
     # .php:264-272); both are the same resample here (thumbnail only adds
     # metadata stripping, which is a host/encode concern).
 
-    # normalize IM's spelling variants (LinearGray / linear-gray / Linear
-    # Gray all name one colorspace in IM's MagickCore option table)
-    colorspace_raw = re.sub(
-        r"[^a-z0-9]", "", str(options.get_option("colorspace") or "").lower()
-    )
-    colorspace = None
-    if colorspace_raw in ("gray", "grey", "grayscale", "lineargray", "rec709luma"):
-        colorspace = "gray"
-    elif colorspace_raw == "rec601luma":
-        colorspace = "gray601"  # SD-video luma weights, distinct from 709
-    elif colorspace_raw in ("", "none", "srgb", "rgb"):
-        # sRGB/RGB are the pipeline's native space — IM's -colorspace
-        # there is an (effective) identity on 8-bit sRGB input
-        colorspace = None
-    else:
-        # every other IM colorspace (cmyk, lab, hsl, ...) would change the
-        # stored sample meaning; refusing loudly beats the old silent
-        # no-op, which served sRGB bytes while the URL claimed otherwise
-        # (reference forwards the value to convert, ImageProcessor.php:88)
-        raise InvalidArgumentException(
-            f"unsupported colorspace {colorspace_raw!r} (supported: gray/"
-            "grey/grayscale/lineargray/rec601luma/rec709luma, srgb, rgb)"
-        )
+    colorspace = parse_colorspace(options)
 
     monochrome = options.truthy("monochrome")
 

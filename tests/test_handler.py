@@ -716,8 +716,8 @@ def test_sampling_factor_grammar_honored(env):
 
 
 def test_unsupported_colorspace_rejected(env):
-    """Non-gray clsp_ values are refused loudly (the old silent no-op
-    served sRGB bytes while the URL claimed e.g. CMYK); the gray family
+    """Unsupported clsp_ values are refused loudly (the old silent no-op
+    served sRGB bytes while the URL claimed otherwise); the gray family
     and srgb/rgb identities still work."""
     handler, _, tmp = env
     src = _write_jpg(tmp / "c.jpg")
@@ -727,7 +727,33 @@ def test_unsupported_colorspace_rejected(env):
     ok = handler.process_image("w_100,o_jpg,clsp_sRGB", src)
     assert _fmt(ok.content) == "JPEG"
     with pytest.raises(InvalidArgumentException):
-        handler.process_image("w_100,o_jpg,clsp_CMYK", src)
+        handler.process_image("w_100,o_jpg,clsp_Lab", src)
+
+
+def test_cmyk_colorspace_output(env):
+    """clsp_CMYK stores real CMYK samples in the JPEG container (IM's
+    black-extraction conversion, Adobe convention); the multiplicative
+    inverse recovers the sRGB pixels up to quantization. Non-JPEG
+    containers cannot store CMYK -> 400."""
+    handler, _, tmp = env
+    src = _write_jpg(tmp / "k.jpg")
+    out = handler.process_image("w_100,o_jpg,clsp_CMYK", src)
+    im = Image.open(io.BytesIO(out.content))
+    assert im.mode == "CMYK"
+    rgb_back = np.asarray(im.convert("RGB"))
+    plain = handler.process_image("w_100,o_jpg,clsp_sRGB", src)
+    rgb_ref = np.asarray(
+        Image.open(io.BytesIO(plain.content)).convert("RGB")
+    )
+    assert rgb_back.shape == rgb_ref.shape
+    # both are q90 JPEG round-trips of the same frame; the CMYK leg adds
+    # a colorspace quantization and 4-channel DCT error (noise-content
+    # source, so the bound is loose; the geometry/mode checks above pin
+    # the real contract)
+    diff = np.abs(rgb_back.astype(int) - rgb_ref.astype(int))
+    assert float(diff.mean()) < 10.0, float(diff.mean())
+    with pytest.raises(InvalidArgumentException):
+        handler.process_image("w_100,o_png,clsp_CMYK", src)
 
 
 def _gif_with_disposal(path):
@@ -1004,3 +1030,14 @@ def test_extract_plus_single_op_skips_tiling_and_crops(tmp_path):
     img = np.asarray(Image.open(io.BytesIO(out.content)))
     assert img.shape[:2] == (200, 100)  # the extract window, not 2048x256
     assert "flyimg_tiled_single_ops_total" not in metrics.summary()
+
+
+def test_cmyk_with_animated_gif_output_refused_early(env):
+    # the CMYK container check runs BEFORE the animation branch — without
+    # it, the multi-frame encoder (which bypasses _encode_one) would
+    # silently serve RGB GIF bytes under a URL claiming CMYK
+    handler, _, tmp = env
+    src = str(tmp / "anim.gif")
+    _gif_with_disposal(src)
+    with pytest.raises(InvalidArgumentException):
+        handler.process_image("o_gif,clsp_CMYK", src)

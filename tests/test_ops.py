@@ -232,3 +232,32 @@ def test_flt_gaussian_resize_differs_from_triangle_and_blurs():
         d = np.diff(a.astype(np.float64), axis=1)
         return float(np.mean(d * d))
     assert hf_energy(gauss) < hf_energy(lanc)
+
+
+def test_fold2d_bf16_form_matches_einsum_within_one_level(monkeypatch):
+    # the resample_experiment candidate wired into serving behind
+    # FLYIMG_RESAMPLE_FORM: same weights, different contraction layout +
+    # explicit bf16 operands with f32 accumulation — must round-trip to
+    # within one uint8 level of the shipped einsum form
+    import jax.numpy as jnp
+
+    from flyimg_tpu.ops import resample as rs
+
+    img = make_test_image(160, 200, seed=9).astype(np.float32)
+    args = (
+        jnp.asarray(img), (75, 62),
+        jnp.array([10.0, 140.0], jnp.float32),
+        jnp.array([0.0, 200.0], jnp.float32),
+        jnp.array([75.0, 62.0], jnp.float32),
+        jnp.array([160.0, 200.0], jnp.float32),
+    )
+    base = np.asarray(rs.resample_image(*args))
+    monkeypatch.setattr(rs, "RESAMPLE_FORM", "fold2d_bf16")
+    alt = np.asarray(rs.resample_image(*args))
+    a = np.clip(base + 0.5, 0, 255).astype(np.uint8)
+    b = np.clip(alt + 0.5, 0, 255).astype(np.uint8)
+    # on CPU the einsum base runs FULL f32 (DEFAULT precision only means
+    # bf16 on TPU), so this compares f32 vs explicit-bf16: two rounding
+    # quanta is the honest bound. On TPU both forms multiply in bf16 and
+    # the experiment gates the A/B at one level against the on-chip base.
+    assert np.abs(a.astype(int) - b.astype(int)).max() <= 2
