@@ -64,7 +64,18 @@ class HaarBackend:
 
 class BlazeFaceBackend:
     """BlazeFace convnet detection; fixed 128x128 input makes batched
-    serving trivial (one jitted program, period)."""
+    serving trivial (one jitted program, period).
+
+    Serving role (round-5 decision, benchmarks/blazeface_eval_r5.json —
+    300 held-out composite scenes vs the Haar oracle): at the 0.8
+    operating point BlazeFace recovers 98% of Haar's boxes at mean IoU
+    0.86 but still proposes ~0.19 extra boxes per Haar box (P 0.82, and
+    some of those are pasted faces Haar itself missed). That asymmetry
+    sets the default: ``auto`` keeps Haar first — fb_1 pixelating a
+    non-face is the costly error — and BlazeFace is the explicit choice
+    when batched-throughput wins: it is the ONE detector whose work is a
+    single fixed-shape jitted program, so concurrent face requests ride
+    the device batcher instead of per-image host Haar scans."""
 
     def __init__(self, checkpoint: str, *, score_threshold: float = 0.8) -> None:
         from flyimg_tpu.models import blazeface
