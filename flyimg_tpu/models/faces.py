@@ -75,7 +75,13 @@ class BlazeFaceBackend:
     non-face is the costly error — and BlazeFace is the explicit choice
     when batched-throughput wins: it is the ONE detector whose work is a
     single fixed-shape jitted program, so concurrent face requests ride
-    the device batcher instead of per-image host Haar scans."""
+    the device batcher instead of per-image host Haar scans.
+
+    Why not a higher threshold: on composites, precision keeps rising to
+    0.94 at score 0.95 (blazeface_eval_hi_r5.json) — but the REAL-photo
+    fixtures break there (portrait 0/1, group photo 2/4; the composite
+    score distribution does not transfer), so 0.8 is the highest point
+    that holds the fixture gates (tests/test_faces.py) and stays."""
 
     def __init__(self, checkpoint: str, *, score_threshold: float = 0.8) -> None:
         from flyimg_tpu.models import blazeface

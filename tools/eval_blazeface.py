@@ -77,6 +77,9 @@ def main() -> int:
     ap.add_argument("--match-iou", type=float, default=0.35,
                     help="IoU at which a BlazeFace box matches a Haar box "
                          "(the serving gate's threshold)")
+    ap.add_argument("--thresholds",
+                    default="0.1,0.2,0.3,0.4,0.5,0.6,0.7,0.8",
+                    help="comma list of score thresholds to sweep")
     args = ap.parse_args()
 
     # a bare JAX_PLATFORMS=cpu is overridden by this environment's
@@ -131,8 +134,8 @@ def main() -> int:
     t_haar = time.time() - t0
 
     # sweep runs the REAL serving entry point per threshold (no private
-    # scored API): 8 x n jitted inferences, cheap at 256^2
-    thresholds = [0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8]
+    # scored API): len(thresholds) x n jitted inferences, cheap at 256^2
+    thresholds = [float(t) for t in args.thresholds.split(",")]
     t0 = time.time()
     per_thr = {
         thr: [bf.detect_faces(params, s, score_threshold=thr)
