@@ -22,6 +22,14 @@ sys.exit(0 if probe_selected_backend(90.0) else 1)
     # drop still leaves partial committed evidence
     if python tools/chip_suite.py --round r5 2>>var/tmp/tunnel_watch.log; then
       echo "chip_suite captured" >&2
+      # both headline variants, unattended: the driver's BENCH runs the
+      # default (einsum) form; this records what the fold2d_bf16 serving
+      # form does in the same window so the flip decision has its number
+      # even if no one is at the keyboard when the window opens
+      FLYIMG_RESAMPLE_FORM=fold2d_bf16 FLYIMG_BENCH_SKIP_PROBE=1 \
+        FLYIMG_BENCH_DEADLINE=900 python bench.py \
+        > benchmarks/bench_tpu_r5_fold2d.jsonl 2>>var/tmp/tunnel_watch.log
+      echo "fold2d bench rc=$?" >&2
       exit 0
     fi
     # rc!=0: chip_suite's stricter backend=='tpu' gate refused the window
