@@ -115,27 +115,6 @@ def _accelerator_expected() -> bool:
     return _noncpu_plugin_available()
 
 
-def _selected_backend_name(timeout_s: float) -> str:
-    """Which backend would a child process actually get? A disposable
-    child applies the env pin and prints ``jax.default_backend()``.
-    Returns '' on failure/timeout. Cheap (seconds) next to a bench child —
-    the hunt uses it to avoid paying for a full CPU measurement when the
-    selection silently degraded (accelerator init failed fast and jax
-    fell back to cpu, which still passes the compute probe)."""
-    code = (
-        f"import sys; sys.path.insert(0, "
-        f"{os.path.dirname(os.path.abspath(__file__))!r});"
-        "from flyimg_tpu.parallel.mesh import ensure_env_platform;"
-        "ensure_env_platform(); import jax; print(jax.default_backend())"
-    )
-    rc, out = _run_abandonable(
-        [sys.executable, "-c", code], timeout_s, capture=True
-    )
-    if rc == 0 and out.strip():
-        return out.strip().splitlines()[-1].strip()
-    return ""
-
-
 def _supervise() -> None:
     """Parent mode: HUNT for a live accelerator window, then run the real
     bench in a DISPOSABLE child with a hard deadline — the tunnel has been
@@ -172,11 +151,18 @@ def _supervise() -> None:
         if budget < min_attempt:
             print("# hunt budget exhausted; CPU fallback", file=sys.stderr)
             break
-        if skip_probe or _probe_backend(min(PROBE_TIMEOUT_S, budget)):
+        if skip_probe:
+            probe_ok, probe_name = True, ""
+        else:
+            # ONE child answers both "does compute work" and "on which
+            # backend" — a second name-check subprocess would double the
+            # per-window overhead through the slow tunnel
+            probe_ok, probe_name = probe_selected_backend(
+                min(PROBE_TIMEOUT_S, budget), capture_name=True
+            )
+        if probe_ok:
             skip_probe = False
-            if hunting and _selected_backend_name(
-                min(PROBE_TIMEOUT_S, budget)
-            ) == "cpu":
+            if hunting and probe_name == "cpu":
                 # probe passed on jax's silent cpu fallback (accelerator
                 # init failing fast): a bench child would only re-measure
                 # CPU — keep hunting instead of paying for it every window

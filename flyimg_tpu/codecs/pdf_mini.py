@@ -74,6 +74,13 @@ def _bounded_inflate(data: bytes, cap: int = MAX_STREAM_BYTES) -> bytes:
 # raster ceilings bound allocation: enough for A4-at-600dpi gray or
 # A4-at-300dpi RGB scans, refusal beyond (ghostscript covers the rest).
 MAX_PREDICTOR_BYTES = 48 * 1024 * 1024
+# The none/up/sub filters are vectorized (numpy row ops); average/Paeth
+# run the bytearray scalar loop at ~0.4 s/MB. A hostile all-Paeth stream
+# at the 48 MB cap would still burn ~18 s of CPU per request, so SCALAR
+# rows get their own much tighter cumulative ceiling (~5 s worst case;
+# covers an A4 300-dpi gray scan even if its encoder chose Paeth for
+# every row — bigger all-Paeth documents go to ghostscript).
+MAX_PREDICTOR_SCALAR_BYTES = 12 * 1024 * 1024
 
 
 def _png_unfilter(data: bytes, columns: int, colors: int) -> bytes:
@@ -96,6 +103,7 @@ def _png_unfilter(data: bytes, columns: int, colors: int) -> bytes:
     out = bytearray(nrows * rowlen)
     prev = bytes(rowlen)
     mv = memoryview(data)
+    scalar_bytes = 0
     for r in range(nrows):
         ft = data[r * stride]
         row = mv[r * stride + 1 : (r + 1) * stride]
@@ -112,6 +120,11 @@ def _png_unfilter(data: bytes, columns: int, colors: int) -> bytes:
                 np.uint8
             ).tobytes()
         elif ft in (3, 4):
+            scalar_bytes += rowlen
+            if scalar_bytes > MAX_PREDICTOR_SCALAR_BYTES:
+                raise PdfRefusal(
+                    "predictor stream exceeds the average/Paeth CPU ceiling"
+                )
             rb = bytes(row)
             buf = bytearray(rowlen)
             for i in range(rowlen):

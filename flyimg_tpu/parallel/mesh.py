@@ -78,12 +78,16 @@ COMPUTE_PROBE_SNIPPET = (
 )
 
 
-def probe_selected_backend(timeout_s: float) -> bool:
+def probe_selected_backend(timeout_s: float, capture_name: bool = False):
     """Run the compute probe in a disposable child against the SAME
     platform selection this process would use (the child re-applies the
     env pin via ensure_env_platform — its own sitecustomize would
-    otherwise override the inherited env var). True iff the probe child
-    exits 0 within the deadline.
+    otherwise override the inherited env var). Returns True iff the probe
+    child exits 0 within the deadline; with ``capture_name`` returns
+    ``(ok, backend_name)`` from the same child — callers that must also
+    distinguish a silent cpu degradation (accelerator init failed fast,
+    jax fell back, the matmul passed on cpu) get both answers for ONE
+    python+jax subprocess boot instead of two.
 
     Popen + poll + ABANDON on expiry: a tunnel-hung child can sit in
     uninterruptible kernel I/O where even SIGKILL doesn't reap it, and a
@@ -102,11 +106,21 @@ def probe_selected_backend(timeout_s: float) -> bool:
         f"import sys; sys.path.insert(0, {repo_root!r});"
         "from flyimg_tpu.parallel.mesh import ensure_env_platform;"
         "ensure_env_platform();" + COMPUTE_PROBE_SNIPPET
+        + ";import jax;print(jax.default_backend())"
     )
     proc = subprocess.Popen(
         [sys.executable, "-c", probe],
-        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        stdout=subprocess.PIPE if capture_name else subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        text=True,
     )
+    chunks: list = []
+    reader = None
+    if capture_name and proc.stdout:
+        reader = threading.Thread(
+            target=lambda: chunks.append(proc.stdout.read()), daemon=True
+        )
+        reader.start()
     deadline = time.monotonic() + timeout_s
     rc = None
     while time.monotonic() < deadline:
@@ -121,7 +135,15 @@ def probe_selected_backend(timeout_s: float) -> bool:
     if rc is None:
         proc.kill()
         threading.Thread(target=proc.wait, daemon=True).start()
-    return rc == 0
+    if not capture_name:
+        return rc == 0
+    if reader:
+        reader.join(timeout=5)
+    name = ""
+    text = "".join(chunks).strip()
+    if rc == 0 and text:
+        name = text.splitlines()[-1].strip()
+    return rc == 0, name
 
 
 def _noncpu_plugin_available() -> bool:

@@ -87,8 +87,9 @@ def build_batched_program(
     )
 
 
-@dataclass
-class _Pending:
+@dataclass(eq=False)  # identity equality: generated __eq__ would compare
+class _Pending:       # ndarray fields ("truth value is ambiguous" in any
+    # list membership test over in-flight batches)
     image: np.ndarray               # [h, w, 3] uint8 (or aux payload)
     plan: Optional[TransformPlan]
     future: Future
@@ -325,12 +326,17 @@ class BatchController:
                 m for batch in self._inflight_batches for m in batch
             ]
         for member in leftovers:
-            if not member.future.done():
+            try:
                 member.future.set_exception(
                     TimeoutError(
                         "batcher closed while a device readback hung"
                     )
                 )
+            except Exception:
+                # a still-running drain thread can win the race and
+                # resolve the future between our snapshot and here —
+                # that's a success, not a shutdown error
+                pass
 
     # ------------------------------------------------------------------
 

@@ -447,7 +447,10 @@ class ImageHandler:
             # container stores CMYK samples (reference: IM converts and
             # writes CMYK JPEGs transparently, ImageProcessor.php:88).
             # Container validity was checked before any decode/device work
-            # (_process_new).
+            # (_process_new). sf_ still validates — an invalid value is a
+            # 400 on every jpg path, even though CMYK's 4-channel encode
+            # does not subsample
+            parse_sampling_factor(sampling_factor)
             return _encode_cmyk_jpeg(frame, spec, quality, mozjpeg)
         if (
             self.codec_batcher is not None
@@ -522,16 +525,8 @@ class ImageHandler:
         # before decode and device work — and before the animation branch,
         # whose encoder would otherwise silently serve RGB GIF bytes under
         # a URL claiming CMYK
-        if (
-            parse_colorspace(options) == "cmyk"
-            and spec.extension not in ("jpg", "jpeg")
-        ):
-            from flyimg_tpu.exceptions import InvalidArgumentException
-
-            raise InvalidArgumentException(
-                "clsp_CMYK requires a JPEG output container (o_jpg); "
-                f"{spec.extension!r} cannot store CMYK samples"
-            )
+        if parse_colorspace(options) == "cmyk":
+            _require_cmyk_container(spec)
         # decode target hint for JPEG DCT prescale (scale-aware)
         hint = decode_target_hint(options)
 
@@ -792,6 +787,19 @@ def _decode_all_frames(data: bytes) -> _Animation:
     )
 
 
+def _require_cmyk_container(spec) -> None:
+    """THE clsp_CMYK container rule (one copy): only JPEG stores CMYK
+    samples. Called before any decode/device work in _process_new and
+    again by the encoder for direct callers."""
+    if spec.extension not in ("jpg", "jpeg"):
+        from flyimg_tpu.exceptions import InvalidArgumentException
+
+        raise InvalidArgumentException(
+            "clsp_CMYK requires a JPEG output container (o_jpg); "
+            f"{spec.extension!r} cannot store CMYK samples"
+        )
+
+
 def _encode_cmyk_jpeg(frame: np.ndarray, spec, quality: int,
                       optimize: bool) -> bytes:
     """clsp_CMYK output: IM's sRGB->CMYK black-extraction conversion
@@ -807,11 +815,8 @@ def _encode_cmyk_jpeg(frame: np.ndarray, spec, quality: int,
 
     from flyimg_tpu.exceptions import InvalidArgumentException
 
-    if spec.extension not in ("jpg", "jpeg"):
-        raise InvalidArgumentException(
-            "clsp_CMYK requires a JPEG output container (o_jpg); "
-            f"{spec.extension!r} cannot store CMYK samples"
-        )
+    _require_cmyk_container(spec)  # _process_new already refused; guard
+    # stays for direct/library callers of the encode path
     f = frame.astype(np.float32) / 255.0
     cmy = 1.0 - f
     k = cmy.min(axis=2, keepdims=True)

@@ -403,3 +403,28 @@ def test_close_drains_inflight_readbacks():
     for f in futs:
         out = f.result(timeout=60)  # already resolved by close()
         assert out.shape[1] == 100
+
+
+def test_equal_length_inflight_batches_drain_cleanly():
+    # _Pending must use identity equality: with the generated dataclass
+    # __eq__, comparing one in-flight batch against another EQUAL-LENGTH
+    # batch evaluates ndarray == ndarray and raises "truth value is
+    # ambiguous" inside _drain's bookkeeping, leaking the entry forever
+    ctl = BatchController(max_batch=2, deadline_ms=1.0, pipeline_depth=2)
+    try:
+        futs = []
+        for i in range(8):  # four consecutive equal-sized batches
+            img = make_test_image(400, 300, seed=80 + i)
+            futs.append(ctl.submit(img, _plan("w_100", 400, 300)))
+        for f in futs:
+            assert f.result(timeout=120).shape[1] == 100
+        # every batch's bookkeeping entry must be gone
+        deadline = __import__("time").monotonic() + 10
+        while __import__("time").monotonic() < deadline:
+            with ctl._lock:
+                if not ctl._inflight_batches:
+                    break
+        with ctl._lock:
+            assert not ctl._inflight_batches
+    finally:
+        ctl.close()

@@ -36,9 +36,13 @@ def test_last_json_line_picks_last_object():
     assert bench._last_json_line("no json here") == ""
 
 
-def test_selected_backend_name_reports_cpu_under_pin(monkeypatch):
-    # the cheap gate that keeps the hunt from re-measuring a silently
-    # degraded CPU backend: under a cpu pin the child reports 'cpu'
+def test_probe_reports_backend_name_under_pin(monkeypatch):
+    # the gate that keeps the hunt from re-measuring a silently degraded
+    # CPU backend: the ONE probe child reports both liveness and which
+    # backend answered
+    from flyimg_tpu.parallel.mesh import probe_selected_backend
+
     monkeypatch.setenv("JAX_PLATFORMS", "cpu")
-    name = bench._selected_backend_name(120.0)
+    ok, name = probe_selected_backend(120.0, capture_name=True)
+    assert ok is True
     assert name == "cpu"

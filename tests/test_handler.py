@@ -1041,3 +1041,11 @@ def test_cmyk_with_animated_gif_output_refused_early(env):
     _gif_with_disposal(src)
     with pytest.raises(InvalidArgumentException):
         handler.process_image("o_gif,clsp_CMYK", src)
+
+
+def test_cmyk_still_validates_sampling_factor(env):
+    # the CMYK early return must not bypass sf_ grammar validation
+    handler, _, tmp = env
+    src = _write_jpg(tmp / "ksf.jpg")
+    with pytest.raises(InvalidArgumentException):
+        handler.process_image("w_100,o_jpg,clsp_CMYK,sf_banana", src)

@@ -621,3 +621,20 @@ def test_malformed_packed_object_skipped_not_fatal():
     }
     arr = MiniPdf(_pdf15(objs)).rasterize(1, 72)
     assert (arr == [10, 200, 30]).all()
+
+
+def test_paeth_heavy_predictor_stream_hits_scalar_ceiling():
+    # average/Paeth rows run a Python-loop decode path; a hostile
+    # all-Paeth stream must refuse at the tight scalar ceiling, far below
+    # the general predictor byte cap (DoS bound, round-5 review)
+    from flyimg_tpu.codecs.pdf_mini import (
+        MAX_PREDICTOR_SCALAR_BYTES,
+        _png_unfilter,
+    )
+
+    columns = 64 * 1024
+    rowlen = columns
+    nrows = MAX_PREDICTOR_SCALAR_BYTES // rowlen + 2
+    data = (b"\x04" + b"\x00" * rowlen) * nrows
+    with pytest.raises(PdfRefusal):
+        _png_unfilter(data, columns, 1)
