@@ -21,7 +21,14 @@ onto (8,128) tiles. Variants:
                 (lanczos3 support is 10-13 taps at these scales): gather
                 a static K=16-tap band per output row and contract over
                 K — ~30x fewer MACs than the dense matmuls, traded
-                against gather cost and a VPU (not MXU) reduction
+                against gather cost and a VPU (not MXU) reduction.
+                Serving integration, if this wins on-chip: K cannot be a
+                global constant (out_true can be far below the static
+                bucket — a w_10 thumbnail of a 4000px source needs
+                radius 3*scale taps), so K must be computed from the
+                PLAN's true geometry at submit time and carried as a
+                static component of the program cache key (the batcher
+                then groups members by K bucket like it groups by shape)
 
 Measured with the repo's hardened recipe: inputs as jit parameters,
 host-read sync, two-scan differencing (see bench.py docstring). Each
