@@ -45,6 +45,35 @@ SERVER_DEFAULTS: Dict[str, Any] = {
     # 1 = strict serial launch->read). See runtime/batcher.py.
     "batch_pipeline_depth": 2,
     "device_mesh": "auto",
+    # --- resilience knobs (runtime/resilience.py; docs/architecture.md
+    # "Resilience") ---
+    # per-request latency budget, minted at HTTP ingress and consumed by
+    # fetch/decode/batch-wait/encode; exhaustion -> 504. 0 = unbounded.
+    "request_deadline_s": 0.0,
+    # source-fetch component timeouts (httpx.Timeout): a blackholed origin
+    # fails at the connect cap, not a flat 30s
+    "fetch_connect_timeout_s": 3.0,
+    "fetch_read_timeout_s": 10.0,
+    "fetch_write_timeout_s": 10.0,
+    # transient-failure retry: capped exponential backoff, FULL jitter
+    "retry_max_attempts": 3,
+    "retry_base_backoff_s": 0.05,
+    "retry_max_backoff_s": 2.0,
+    # per-upstream-host circuit breaker: consecutive transient failures to
+    # trip open, and how long an open breaker sheds before one probe
+    "breaker_failure_threshold": 5,
+    "breaker_recovery_s": 10.0,
+    # admission control: max pending (queued or executing) submissions per
+    # batch controller before new work sheds as 503 + Retry-After
+    # (0 = unbounded), and the Retry-After value shed responses carry
+    "batch_max_queue_depth": 0,
+    "decode_max_queue_depth": 0,
+    "shed_retry_after_s": 1.0,
+    # ceiling on ONE batched-result wait; on expiry the request degrades
+    # to the direct single-image program (wedged_executor_fallback) or
+    # sheds as 503
+    "device_result_timeout_s": 120.0,
+    "wedged_executor_fallback": True,
 }
 
 

@@ -129,6 +129,20 @@ def encode(
             subsampling = 1          # 4:2:2 (also stands in for 4:4:0)
         else:
             subsampling = 2          # 4:2:0 and coarser
+        if mozjpeg:
+            # progressive + optimize buffers the WHOLE scan train before
+            # emitting; PIL's bufsize estimate undershoots for
+            # high-entropy 4:4:4 content and libjpeg dies with
+            # "Suspension not allowed here" — give it room. The bump is
+            # monotonic (restoring would race concurrent encoder threads)
+            # and CAPPED so one giant image can't make every later save
+            # in the process allocate a worst-case buffer; beyond the cap
+            # such saves fail exactly as they did before the bump.
+            from PIL import ImageFile
+
+            needed = min(pil.size[0] * pil.size[1] * 3 * 2, 32 * 1024 * 1024)
+            if ImageFile.MAXBLOCK < needed:
+                ImageFile.MAXBLOCK = needed
         pil.save(
             buf,
             "JPEG",

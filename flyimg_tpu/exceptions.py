@@ -43,7 +43,17 @@ class UnsupportedMediaException(AppException):
 
 
 class ServiceUnavailableException(AppException):
-    """The device pipeline did not produce a result in time (wedged
-    executor or a coalesced leader that never completed). Maps to 503 so
-    load balancers shed/retry instead of holding sockets open. No reference
+    """The service is shedding this request: a wedged device pipeline, a
+    full admission queue, or an open upstream circuit. Maps to 503 (+
+    Retry-After from the ``retry_after_s`` attribute when set) so load
+    balancers shed/retry instead of holding sockets open. No reference
     analog (its per-request exec model cannot wedge followers)."""
+
+    #: advisory client backoff, surfaced as the Retry-After header
+    retry_after_s: int = 1
+
+
+class DeadlineExceededException(AppException):
+    """The per-request latency budget (runtime/resilience.py Deadline) ran
+    out mid-pipeline. Maps to 504: the request fails fast instead of
+    holding a socket for the sum of every remaining stage timeout."""

@@ -34,9 +34,14 @@ def force_cpu_platform(n_devices: int = 1) -> None:
     jax.config.update("jax_platforms", "cpu")
     # XLA_FLAGS is parsed C++-side only at the process's FIRST client init;
     # if any client already existed (this env's sitecustomize can create
-    # one at interpreter start) the flag is a no-op, so set the documented
-    # Python-level device count too (jax>=0.4.34).
-    jax.config.update("jax_num_cpu_devices", n_devices)
+    # one at interpreter start) the flag is a no-op, so set the
+    # Python-level device count too where this jax exposes it (the option
+    # is not present in every release; XLA_FLAGS remains the only lever
+    # on versions without it).
+    try:
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    except AttributeError:
+        pass
 
 
 def ensure_env_platform() -> None:

@@ -46,6 +46,7 @@ from flyimg_tpu.ops.compose import (
     plan_layout,
 )
 from flyimg_tpu.spec.plan import TransformPlan
+from flyimg_tpu.testing import faults
 
 MAX_BATCH_BUCKET = 64
 
@@ -127,8 +128,11 @@ class BatchController:
         mesh=None,
         lone_flush: bool = True,
         pipeline_depth: int = 2,
+        max_queue_depth: int = 0,
+        shed_retry_after_s: float = 1.0,
     ) -> None:
         from flyimg_tpu.runtime.metrics import MetricsRegistry
+        from flyimg_tpu.runtime.resilience import AdmissionGate
 
         self.max_batch = max_batch
         self.deadline_s = deadline_ms / 1000.0
@@ -146,6 +150,16 @@ class BatchController:
         # single source of truth for batch accounting; the app passes its
         # shared registry, standalone use gets a private one
         self.metrics = metrics if metrics is not None else MetricsRegistry()
+        # admission control: "pending" = submitted and not yet resolved
+        # (queued OR executing). When the bound is hit, submit sheds with
+        # a 503 + Retry-After instead of queueing into collapse; 0 keeps
+        # the legacy unbounded behavior (runtime/resilience.py).
+        self.admission = AdmissionGate(
+            max_pending=int(max_queue_depth),
+            retry_after_s=shed_retry_after_s,
+            name="batch queue",
+            metrics=self.metrics,
+        )
         self._groups: Dict[Tuple, _Group] = {}
         self._lock = threading.Condition()
         self._stop = False
@@ -241,23 +255,19 @@ class BatchController:
             final_true=final_true,
             needs_slice=needs_slice,
         )
-        with self._lock:
-            if self._stop:
-                raise RuntimeError("batcher is closed")
-            group = self._groups.get(key)
-            if group is None:
-                group = _Group(
-                    key=key,
-                    in_shape=in_shape,
-                    resample_out=resample_out,
-                    pad_canvas=layout.pad_canvas,
-                    pad_offset=layout.pad_offset,
-                    device_plan=device_plan,
-                    rotate_dynamic=rotate_dynamic,
-                )
-                self._groups[key] = group
-            group.members.append(pending)
-            self._lock.notify()
+        self._admit_and_enqueue(
+            key,
+            pending,
+            lambda: _Group(
+                key=key,
+                in_shape=in_shape,
+                resample_out=resample_out,
+                pad_canvas=layout.pad_canvas,
+                pad_offset=layout.pad_offset,
+                device_plan=device_plan,
+                rotate_dynamic=rotate_dynamic,
+            ),
+        )
         return future
 
     def submit_aux(self, key: Tuple, payload, runner) -> Future:
@@ -276,24 +286,47 @@ class BatchController:
             final_true=(0, 0),
         )
         full_key = ("aux", runner, key)
-        with self._lock:
-            if self._stop:
-                raise RuntimeError("batcher is closed")
-            group = self._groups.get(full_key)
-            if group is None:
-                group = _Group(
-                    key=full_key,
-                    in_shape=(0, 0),
-                    resample_out=None,
-                    pad_canvas=None,
-                    pad_offset=(0, 0),
-                    device_plan=None,
-                    runner=runner,
-                )
-                self._groups[full_key] = group
-            group.members.append(pending)
-            self._lock.notify()
+        # same admission bound as transform submissions: aux work holds
+        # executor time too, so overload must shed it the same way
+        self._admit_and_enqueue(
+            full_key,
+            pending,
+            lambda: _Group(
+                key=full_key,
+                in_shape=(0, 0),
+                resample_out=None,
+                pad_canvas=None,
+                pad_offset=(0, 0),
+                device_plan=None,
+                runner=runner,
+            ),
+        )
         return future
+
+    def _admit_and_enqueue(self, key: Tuple, pending: _Pending, make_group):
+        """THE submission path (submit + submit_aux): admission BEFORE
+        enqueue — over the bound this raises a typed 503 (load shed) in
+        the submitter's thread; the slot frees when the future resolves,
+        however it resolves — then group get-or-create + append under the
+        lock, releasing the admission slot if enqueue itself fails."""
+        self.admission.acquire()
+        pending.future.add_done_callback(
+            lambda _f: self.admission.release()
+        )
+        try:
+            with self._lock:
+                if self._stop:
+                    raise RuntimeError("batcher is closed")
+                group = self._groups.get(key)
+                if group is None:
+                    group = make_group()
+                    self._groups[key] = group
+                group.members.append(pending)
+                self._lock.notify()
+        except BaseException:
+            if not pending.future.done():
+                self.admission.release()
+            raise
 
     def stats(self) -> Dict[str, float]:
         summary = self.metrics.summary()
@@ -444,6 +477,18 @@ class BatchController:
     def _execute(self, group: _Group) -> None:
         members = group.members
         n = len(members)
+        # fault hook: a blocking plan here wedges the executor thread —
+        # the scenario the handler's wedged-executor fallback defends
+        # against (flyimg_tpu/testing/faults.py). A RAISING plan must
+        # fail this group's futures, never the singleton executor thread
+        # (a dead executor would strand every later submission).
+        try:
+            faults.fire("batcher.execute", key=group.key, n=n)
+        except Exception as exc:
+            for member in members:
+                if not member.future.done():
+                    member.future.set_exception(exc)
+            return
         if group.runner is not None:
             try:
                 outputs = group.runner([m.image for m in members])

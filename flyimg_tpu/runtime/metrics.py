@@ -138,6 +138,38 @@ class MetricsRegistry:
             "Output-cache lookups",
         ).inc()
 
+    # -- resilience counters (runtime/resilience.py) -----------------------
+
+    def record_retry(self, point: str) -> None:
+        self.counter(
+            f'flyimg_retries_total{{point="{point}"}}',
+            "Transient-failure retries by pipeline point",
+        ).inc()
+
+    def record_breaker(self, host: str, state: str) -> None:
+        # host derives from a client-controlled URL: escape it so a crafted
+        # value cannot break the exposition format (label values allow
+        # escaped \" \\ \n only)
+        safe = (
+            host.replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+        )
+        self.counter(
+            f'flyimg_breaker_transitions_total{{host="{safe}",to="{state}"}}',
+            "Circuit-breaker state transitions by upstream host",
+        ).inc()
+
+    def record_shed(self, reason: str) -> None:
+        self.counter(
+            f'flyimg_shed_total{{reason="{reason}"}}',
+            "Requests shed by admission control / open circuits",
+        ).inc()
+
+    def record_deadline_hit(self, stage: str) -> None:
+        self.counter(
+            f'flyimg_deadline_exceeded_total{{stage="{stage}"}}',
+            "Requests that exhausted their latency budget, by stage",
+        ).inc()
+
     def record_batch(self, images: int, capacity: int) -> None:
         self.counter(
             "flyimg_batches_total", "Device batches executed"

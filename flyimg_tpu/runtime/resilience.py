@@ -1,0 +1,431 @@
+"""Resilience primitives for the serving pipeline.
+
+The reference has none of this: one PHP request = one fetch = one exec, and
+a dead origin simply burns a 30 s socket per request. A batched TPU serving
+tier multiplies every such stall across coalesced followers and batch
+groups, so the non-device path needs the standard serving defenses
+("Beyond Inference" / PATCHEDSERVE, PAPERS.md — host-side stages dominate
+serving tails):
+
+- ``Deadline``: a per-request latency budget minted at ingress and consumed
+  by every stage (fetch, decode, batch-wait, encode). Exhaustion raises
+  ``DeadlineExceededException`` (-> 504) instead of holding the socket for
+  the sum of all stage timeouts.
+- ``RetryPolicy``: capped exponential backoff with FULL jitter (the AWS
+  architecture-blog recommendation: sleep = random(0, min(cap, base*2^n)),
+  which decorrelates synchronized retry storms). Retries only the
+  transient-classified errors its caller passes in and never sleeps past
+  the remaining deadline budget.
+- ``CircuitBreaker`` / ``BreakerRegistry``: per-upstream-host
+  closed -> open -> half-open state machine so a dead origin sheds in
+  microseconds instead of paying a connect timeout per request.
+- ``AdmissionGate``: a bounded pending-work counter; when the queue is
+  full, new work is rejected immediately (``ServiceUnavailableException``
+  with ``retry_after_s`` -> 503 + Retry-After) so overload degrades to
+  fast rejections instead of collapse.
+
+Everything is plain threading + monotonic time — usable from the aiohttp
+executor threads, the batcher, and offline bulk runs alike. Knobs surface
+through appconfig (``resilience_*`` keys); construction helpers read them
+so the wiring in service/app.py stays one line per subsystem.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+from urllib.parse import urlsplit
+
+from flyimg_tpu.exceptions import (
+    DeadlineExceededException,
+    ServiceUnavailableException,
+)
+
+__all__ = [
+    "Deadline",
+    "RetryPolicy",
+    "CircuitBreaker",
+    "BreakerRegistry",
+    "CircuitOpenException",
+    "AdmissionGate",
+    "host_of",
+]
+
+
+# ---------------------------------------------------------------------------
+# Deadline budget
+
+
+class Deadline:
+    """A monotonic per-request latency budget.
+
+    Minted once at ingress; every stage asks ``remaining()`` to bound its
+    own wait and ``check(stage)`` to fail fast when the budget is gone.
+    ``None`` budget (or <= 0 config) means unbounded — every method then
+    degrades to a no-op so call sites need no branching.
+    """
+
+    __slots__ = ("_deadline_at", "budget_s", "_metrics", "_clock")
+
+    def __init__(
+        self,
+        budget_s: Optional[float],
+        *,
+        metrics=None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.budget_s = budget_s if budget_s and budget_s > 0 else None
+        self._clock = clock
+        self._deadline_at = (
+            clock() + self.budget_s if self.budget_s is not None else None
+        )
+        self._metrics = metrics
+
+    @property
+    def expired(self) -> bool:
+        return (
+            self._deadline_at is not None
+            and self._clock() >= self._deadline_at
+        )
+
+    def remaining(self) -> float:
+        """Seconds left; ``inf`` when unbounded, floored at 0."""
+        if self._deadline_at is None:
+            return float("inf")
+        return max(self._deadline_at - self._clock(), 0.0)
+
+    def timeout(self, cap: Optional[float] = None) -> Optional[float]:
+        """A wait timeout bounded by BOTH the stage cap and the remaining
+        budget — the value every blocking call in the pipeline should use.
+        Returns None only when both are unbounded."""
+        rem = self.remaining()
+        if cap is None:
+            return None if rem == float("inf") else rem
+        return min(cap, rem) if rem != float("inf") else cap
+
+    def check(self, stage: str = "") -> None:
+        """Raise (-> 504) when the budget is exhausted."""
+        if self.expired:
+            if self._metrics is not None:
+                self._metrics.record_deadline_hit(stage or "unknown")
+            raise DeadlineExceededException(
+                f"request deadline exceeded"
+                f"{f' at stage {stage!r}' if stage else ''} "
+                f"(budget {self.budget_s:.3f}s)"
+            )
+
+    @classmethod
+    def from_params(cls, params, *, metrics=None) -> "Deadline":
+        return cls(
+            float(params.by_key("request_deadline_s", 0.0) or 0.0),
+            metrics=metrics,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Retry with exponential backoff + full jitter
+
+
+@dataclass
+class RetryPolicy:
+    """Bounded retry for transient failures.
+
+    ``run`` retries ``fn`` while ``retryable(exc)`` holds, sleeping
+    ``random(0, min(max_backoff, base_backoff * 2**attempt))`` between
+    attempts (full jitter). A deadline bounds the whole affair: when the
+    remaining budget cannot cover the next sleep, the last error propagates
+    immediately — a retry that would overshoot the budget helps nobody.
+    """
+
+    max_attempts: int = 3
+    base_backoff_s: float = 0.05
+    max_backoff_s: float = 2.0
+    # injectable for deterministic tests
+    sleep: Callable[[float], None] = time.sleep
+    rng: Callable[[], float] = random.random
+    metrics: Optional[object] = None
+
+    def backoff(self, attempt: int) -> float:
+        """Full-jitter delay before retry number ``attempt`` (1-based)."""
+        cap = min(self.max_backoff_s, self.base_backoff_s * (2 ** attempt))
+        return self.rng() * cap
+
+    def run(
+        self,
+        fn: Callable[[], object],
+        *,
+        retryable: Callable[[BaseException], bool],
+        deadline: Optional[Deadline] = None,
+        point: str = "",
+    ):
+        attempt = 0
+        while True:
+            if deadline is not None:
+                deadline.check(point or "retry")
+            try:
+                return fn()
+            except Exception as exc:
+                attempt += 1
+                if deadline is not None and deadline.expired:
+                    # the budget died during this attempt: the caller gets
+                    # a deterministic 504, not whatever error the doomed
+                    # attempt happened to surface
+                    deadline.check(point or "retry")
+                if attempt >= self.max_attempts or not retryable(exc):
+                    raise
+                delay = self.backoff(attempt)
+                if deadline is not None and deadline.remaining() <= delay:
+                    # can't afford the backoff: surface the real error now
+                    # rather than burning the caller's last budget asleep
+                    raise
+                if self.metrics is not None:
+                    self.metrics.record_retry(point or "unknown")
+                if delay > 0:
+                    self.sleep(delay)
+
+    @classmethod
+    def from_params(cls, params, *, metrics=None) -> "RetryPolicy":
+        return cls(
+            max_attempts=int(params.by_key("retry_max_attempts", 3)),
+            base_backoff_s=float(params.by_key("retry_base_backoff_s", 0.05)),
+            max_backoff_s=float(params.by_key("retry_max_backoff_s", 2.0)),
+            metrics=metrics,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker
+
+
+class CircuitOpenException(ServiceUnavailableException):
+    """The breaker for this upstream is open: the origin was recently and
+    repeatedly down, so the request sheds instantly instead of paying a
+    connect timeout. 503 + Retry-After (the breaker's own recovery time)."""
+
+
+class CircuitBreaker:
+    """closed -> open -> half-open per-upstream breaker.
+
+    - closed: requests flow; ``failure_threshold`` CONSECUTIVE transient
+      failures trip it open.
+    - open: every ``allow()`` raises ``CircuitOpenException`` (sub-ms)
+      until ``recovery_s`` has elapsed.
+    - half-open: exactly one probe request is let through; its success
+      closes the breaker, its failure re-opens it (fresh recovery window).
+
+    Thread-safe; all transitions are recorded to metrics when given.
+    """
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(
+        self,
+        *,
+        failure_threshold: int = 5,
+        recovery_s: float = 10.0,
+        name: str = "",
+        metrics=None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.failure_threshold = max(1, int(failure_threshold))
+        self.recovery_s = float(recovery_s)
+        self.name = name
+        self._metrics = metrics
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._probe_inflight = False
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def _transition(self, to: str) -> None:
+        # caller holds the lock
+        self._state = to
+        if self._metrics is not None:
+            self._metrics.record_breaker(self.name or "upstream", to)
+
+    def allow(self) -> None:
+        """Admit one attempt or raise ``CircuitOpenException`` (fast)."""
+        with self._lock:
+            if self._state == self.CLOSED:
+                return
+            now = self._clock()
+            if self._state == self.OPEN:
+                remaining = self._opened_at + self.recovery_s - now
+                if remaining > 0:
+                    raise self._rejection(remaining)
+                self._transition(self.HALF_OPEN)
+                self._probe_inflight = False
+            # half-open: one probe at a time; everyone else sheds
+            if self._probe_inflight:
+                raise self._rejection(self.recovery_s)
+            self._probe_inflight = True
+
+    def _rejection(self, retry_after: float) -> CircuitOpenException:
+        exc = CircuitOpenException(
+            f"upstream {self.name or 'origin'!s} circuit is open "
+            f"(recently failing); retry in ~{max(retry_after, 0.0):.1f}s"
+        )
+        exc.retry_after_s = max(1, int(retry_after) or 1)
+        return exc
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive_failures = 0
+            self._probe_inflight = False
+            if self._state != self.CLOSED:
+                self._transition(self.CLOSED)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._probe_inflight = False
+            if self._state == self.HALF_OPEN:
+                # failed probe: straight back to open, fresh window
+                self._opened_at = self._clock()
+                self._transition(self.OPEN)
+                return
+            self._consecutive_failures += 1
+            if (
+                self._state == self.CLOSED
+                and self._consecutive_failures >= self.failure_threshold
+            ):
+                self._opened_at = self._clock()
+                self._transition(self.OPEN)
+
+
+class BreakerRegistry:
+    """One ``CircuitBreaker`` per upstream host, created on first use.
+
+    Hostnames are client-controlled (the imageSrc URL), so cardinality is
+    bounded: past ``max_hosts`` distinct hosts, idle CLOSED breakers are
+    evicted to make room, and when nothing is evictable new hosts share
+    one overflow breaker — a hostname-cycling client cannot grow process
+    memory or metrics label cardinality without limit.
+    """
+
+    OVERFLOW_HOST = "_overflow"
+
+    def __init__(
+        self,
+        *,
+        failure_threshold: int = 5,
+        recovery_s: float = 10.0,
+        metrics=None,
+        max_hosts: int = 1024,
+    ) -> None:
+        self.failure_threshold = failure_threshold
+        self.recovery_s = recovery_s
+        self.max_hosts = max(1, int(max_hosts))
+        self._metrics = metrics
+        self._lock = threading.Lock()
+        self._breakers: Dict[str, CircuitBreaker] = {}
+
+    def _make(self, host: str) -> CircuitBreaker:
+        return CircuitBreaker(
+            failure_threshold=self.failure_threshold,
+            recovery_s=self.recovery_s,
+            name=host,
+            metrics=self._metrics,
+        )
+
+    def for_host(self, host: str) -> CircuitBreaker:
+        with self._lock:
+            breaker = self._breakers.get(host)
+            if breaker is not None:
+                return breaker
+            if len(self._breakers) >= self.max_hosts:
+                idle = next(
+                    (
+                        key
+                        for key, brk in self._breakers.items()
+                        if brk.state == CircuitBreaker.CLOSED
+                        and key != self.OVERFLOW_HOST
+                    ),
+                    None,
+                )
+                if idle is None:  # everything is tracking live failures
+                    breaker = self._breakers.get(self.OVERFLOW_HOST)
+                    if breaker is None:
+                        breaker = self._make(self.OVERFLOW_HOST)
+                        self._breakers[self.OVERFLOW_HOST] = breaker
+                    return breaker
+                del self._breakers[idle]
+            breaker = self._make(host)
+            self._breakers[host] = breaker
+            return breaker
+
+    @classmethod
+    def from_params(cls, params, *, metrics=None) -> "BreakerRegistry":
+        return cls(
+            failure_threshold=int(
+                params.by_key("breaker_failure_threshold", 5)
+            ),
+            recovery_s=float(params.by_key("breaker_recovery_s", 10.0)),
+            metrics=metrics,
+        )
+
+
+def host_of(url: str) -> str:
+    """The breaker key for a source URL: lowercased hostname (+ port) —
+    NOT the raw netloc, whose userinfo part is attacker-controlled and
+    could smuggle quotes into metric labels or split one origin into
+    unbounded keys. Local paths share one bucket (they never trip: local
+    reads are not classified transient)."""
+    try:
+        parts = urlsplit(url)
+        host = parts.hostname or "local"
+        if parts.port:
+            host = f"{host}:{parts.port}"
+        return host
+    except ValueError:
+        return "local"
+
+
+# ---------------------------------------------------------------------------
+# Admission control
+
+
+@dataclass
+class AdmissionGate:
+    """Bounded pending-work admission: at most ``max_pending`` admitted
+    units at once; over that, ``acquire`` sheds instantly with a 503 +
+    Retry-After instead of queueing into collapse. ``max_pending`` <= 0
+    disables the bound (every acquire admits)."""
+
+    max_pending: int = 0
+    retry_after_s: float = 1.0
+    name: str = "queue"
+    metrics: Optional[object] = None
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+    _pending: int = 0
+
+    def acquire(self) -> None:
+        with self._lock:
+            if self.max_pending > 0 and self._pending >= self.max_pending:
+                if self.metrics is not None:
+                    self.metrics.record_shed(self.name)
+                exc = ServiceUnavailableException(
+                    f"{self.name} is full ({self._pending}/"
+                    f"{self.max_pending} pending); shedding load"
+                )
+                exc.retry_after_s = max(1, int(self.retry_after_s))
+                raise exc
+            self._pending += 1
+
+    def release(self) -> None:
+        with self._lock:
+            if self._pending > 0:
+                self._pending -= 1
+
+    @property
+    def pending(self) -> int:
+        with self._lock:
+            return self._pending

@@ -31,6 +31,7 @@ from aiohttp import web
 from flyimg_tpu.appconfig import AppParameters
 from flyimg_tpu.exceptions import (
     AppException,
+    DeadlineExceededException,
     ExecFailedException,
     InvalidArgumentException,
     ReadFileException,
@@ -38,6 +39,7 @@ from flyimg_tpu.exceptions import (
     ServiceUnavailableException,
     UnsupportedMediaException,
 )
+from flyimg_tpu.runtime.resilience import Deadline
 from flyimg_tpu.service.handler import ImageHandler
 from flyimg_tpu.service.response import (
     NOT_MODIFIED_HEADERS,
@@ -63,6 +65,7 @@ _ERROR_STATUS = {
     ReadFileException: 404,
     InvalidArgumentException: 400,
     UnsupportedMediaException: 415,
+    DeadlineExceededException: 504,
     ServiceUnavailableException: 503,
     ExecFailedException: 500,
 }
@@ -117,11 +120,11 @@ function go() {
 
 def make_app(params: Optional[AppParameters] = None) -> web.Application:
     params = params or AppParameters()
-    storage = make_storage(params)
     from flyimg_tpu.runtime import BatchController
     from flyimg_tpu.runtime.metrics import MetricsRegistry
 
     metrics = MetricsRegistry()
+    storage = make_storage(params, metrics=metrics)
     import jax
 
     from flyimg_tpu.parallel.mesh import ensure_live_backend
@@ -183,12 +186,18 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
 
         mesh = make_mesh(devices=local_devices)
         sp_mesh = make_mesh(axis_names=("sp",), devices=local_devices)
+    # admission bound: pending (queued or executing) submissions per
+    # controller; over it, requests shed as 503 + Retry-After instead of
+    # queueing into collapse (runtime/resilience.py). 0 = unbounded.
+    shed_retry_after = float(params.by_key("shed_retry_after_s", 1.0))
     batcher = BatchController(
         max_batch=int(params.by_key("batch_max_size", 64)),
         deadline_ms=float(params.by_key("batch_deadline_ms", 4.0)),
         metrics=metrics,
         mesh=mesh,
         pipeline_depth=int(params.by_key("batch_pipeline_depth", 2)),
+        max_queue_depth=int(params.by_key("batch_max_queue_depth", 0)),
+        shed_retry_after_s=shed_retry_after,
     )
     # host codec work gets its OWN controller/thread: JPEG-miss decode
     # batches (native DecodePool) must not serialize with device launches
@@ -196,7 +205,17 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
         max_batch=int(params.by_key("decode_batch_max", 32)),
         deadline_ms=float(params.by_key("decode_deadline_ms", 1.0)),
         metrics=metrics,
+        max_queue_depth=int(params.by_key("decode_max_queue_depth", 0)),
+        shed_retry_after_s=shed_retry_after,
     )
+    # fault-injection hook (flyimg_tpu/testing/faults.py): tests assemble
+    # a full app with scripted faults at named pipeline points; absent in
+    # production configs
+    injector = params.by_key("fault_injector")
+    if injector is not None:
+        from flyimg_tpu.testing import faults
+
+        faults.install(injector)
     # face engine: 'auto' (haar where cascade XMLs exist, else the skin
     # proposer), 'haar', 'blazeface' (+ face_checkpoint), or 'facefind'
     from flyimg_tpu.models.faces import make_face_backend
@@ -242,6 +261,10 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
     async def _close_batcher(_app):
         batcher.close()
         codec_batcher.close()
+        if injector is not None:
+            from flyimg_tpu.testing import faults
+
+            faults.clear()
 
     app.on_cleanup.append(_close_batcher)
 
@@ -292,11 +315,16 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
     async def _process(request: web.Request):
         options = request.match_info["options"]
         image_src = request.match_info["imageSrc"]
+        # the request's latency budget starts HERE, at ingress — queue
+        # time in the executor counts against it, so an overloaded
+        # worker pool surfaces as fast 504s rather than invisible queueing
+        deadline = Deadline.from_params(params, metrics=metrics)
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(
             None,
             lambda: handler.process_image(
-                options, image_src, accepts_webp=_accepts_webp(request)
+                options, image_src, accepts_webp=_accepts_webp(request),
+                deadline=deadline,
             ),
         )
 
@@ -430,7 +458,16 @@ def _error_response(exc: AppException) -> web.Response:
         if isinstance(exc, cls):
             status = code
             break
-    return web.Response(status=status, text=f"{type(exc).__name__}: {exc}")
+    headers = {}
+    if status == 503:
+        # shed responses advise the client when to come back (admission
+        # control / open breaker set retry_after_s; 1s is the floor)
+        headers["Retry-After"] = str(
+            max(1, int(getattr(exc, "retry_after_s", 1) or 1))
+        )
+    return web.Response(
+        status=status, text=f"{type(exc).__name__}: {exc}", headers=headers
+    )
 
 
 def main(argv=None) -> int:

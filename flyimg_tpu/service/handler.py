@@ -27,7 +27,8 @@ from flyimg_tpu.appconfig import AppParameters
 from flyimg_tpu.codecs import decode, encode, media_info
 from flyimg_tpu.exceptions import ServiceUnavailableException
 from flyimg_tpu.ops.compose import run_plan
-from flyimg_tpu.service.input_source import load_source
+from flyimg_tpu.runtime.resilience import Deadline
+from flyimg_tpu.service.input_source import FetchPolicy, load_source
 from flyimg_tpu.service.output_image import OutputSpec, resolve_output
 from flyimg_tpu.service.security import SecurityHandler
 from flyimg_tpu.spec.options import OptionsBag
@@ -91,8 +92,10 @@ class ProcessedImage:
 class ImageHandler:
     # inputs at least this tall consider the spatially-tiled resample
     TILE_MIN_ROWS = 2048
-    # ceiling on any single wait for a batched device result; a wedged
-    # executor then surfaces as a 500 instead of a stuck worker thread
+    # default ceiling on any single wait for a batched device result
+    # (config-overridable via device_result_timeout_s); a wedged executor
+    # then degrades to the single-image CPU path instead of sticking the
+    # worker thread
     DEVICE_RESULT_TIMEOUT_S = 120.0
 
     def __init__(
@@ -123,6 +126,22 @@ class ImageHandler:
         self._face_backend = face_backend
         self._smartcrop_backend = smartcrop_backend
         self._singleflight = _SingleFlight()
+        # resilience wiring (runtime/resilience.py): fetch retry/breaker
+        # policy, per-request deadline default, wedged-executor behavior
+        self.fetch_policy = FetchPolicy.from_params(params, metrics=metrics)
+        self.default_deadline_s = float(
+            params.by_key("request_deadline_s", 0.0) or 0.0
+        )
+        self.device_result_timeout_s = float(
+            params.by_key(
+                "device_result_timeout_s", self.DEVICE_RESULT_TIMEOUT_S
+            )
+        )
+        # a wedged device executor degrades to the single-image direct
+        # path (CPU-visible jit) instead of failing the request outright
+        self.wedged_fallback = bool(
+            params.by_key("wedged_executor_fallback", True)
+        )
 
     # lazily import model backends so the service can run without them
     def _smartcrop(self):
@@ -154,11 +173,18 @@ class ImageHandler:
         image_src: str,
         *,
         accepts_webp: bool = False,
+        deadline: Optional[Deadline] = None,
     ) -> ProcessedImage:
         """The single choke point every image request goes through
-        (reference ImageHandler::processImage, ImageHandler.php:92-118)."""
+        (reference ImageHandler::processImage, ImageHandler.php:92-118).
+
+        ``deadline`` is the request's latency budget, minted at HTTP
+        ingress; library callers that pass none get the configured default
+        (``request_deadline_s``; 0 = unbounded)."""
         timings: Dict[str, float] = {}
         t0 = time.perf_counter()
+        if deadline is None:
+            deadline = Deadline(self.default_deadline_s, metrics=self.metrics)
 
         options_str, image_src = self.security.check_security_hash(
             options_str, image_src
@@ -177,6 +203,8 @@ class ImageHandler:
             options,
             self.params.by_key("tmp_dir", "var/tmp"),
             header_extra_options=self.params.by_key("header_extra_options", ""),
+            policy=self.fetch_policy,
+            deadline=deadline,
         )
         timings["fetch"] = time.perf_counter() - t0
 
@@ -214,11 +242,15 @@ class ImageHandler:
             try:
                 # generous multiple of the per-device-call budget: a slow
                 # but healthy leader (multi-frame GIF, several post-pass
-                # waits) must NOT shed its followers — only a wedged one
+                # waits) must NOT shed its followers — only a wedged one.
+                # The follower's own deadline caps the wait regardless.
                 content, modified_at = flight.result(
-                    timeout=5 * self.DEVICE_RESULT_TIMEOUT_S
+                    timeout=deadline.timeout(
+                        5 * self.device_result_timeout_s
+                    )
                 )
             except FutureTimeout:
+                deadline.check("coalesced")  # budget gone -> 504, not 503
                 raise ServiceUnavailableException(
                     "timed out waiting for the in-flight pipeline computing "
                     "this output"
@@ -240,7 +272,9 @@ class ImageHandler:
             )
 
         try:
-            content = self._process_new(source.data, options, spec, timings)
+            content = self._process_new(
+                source.data, options, spec, timings, deadline=deadline
+            )
             # write() returns the stored mtime so neither the leader nor
             # its followers re-query metadata for bytes written just now
             modified_at = self.storage.write(spec.name, content)
@@ -266,6 +300,8 @@ class ImageHandler:
         options: OptionsBag,
         spec: OutputSpec,
         timings: Optional[Dict[str, float]] = None,
+        *,
+        deadline: Optional[Deadline] = None,
     ) -> bytes:
         """Public entry for offline callers (the bulk runner): the exact
         cache-miss transform pipeline — decode, device program, smart-crop/
@@ -274,8 +310,55 @@ class ImageHandler:
         single code path is what makes its outputs byte-identical to
         serving for the same options."""
         return self._process_new(
-            data, options, spec, {} if timings is None else timings
+            data, options, spec, {} if timings is None else timings,
+            deadline=deadline,
         )
+
+    # ------------------------------------------------------------------
+    # deadline-aware device waits
+
+    def _device_wait_s(self, deadline: Optional[Deadline]) -> float:
+        """One batched-result wait, bounded by the stage cap AND the
+        remaining request budget."""
+        if deadline is None:
+            return self.device_result_timeout_s
+        return deadline.timeout(self.device_result_timeout_s)
+
+    def _record_wedge(self) -> None:
+        """EVERY wedged-batcher degradation increments the one counter
+        operators watch — transform, decode, encode, and post-pass
+        fallbacks alike (docs/architecture.md "Resilience")."""
+        if self.metrics is not None:
+            self.metrics.counter(
+                "flyimg_wedged_fallbacks_total",
+                "Batched waits that timed out and ran the direct "
+                "single-image path instead",
+            ).inc()
+
+    def _await_transform(
+        self,
+        future: Future,
+        frame: np.ndarray,
+        frame_plan: TransformPlan,
+        deadline: Optional[Deadline],
+    ) -> np.ndarray:
+        """Resolve one batched transform, degrading sanely when it can't:
+        an exhausted budget is a 504 (fail fast, no further waiting); a
+        wedged executor falls back to the direct single-image program in
+        THIS thread (degraded but correct) or, with the fallback disabled,
+        sheds as a 503."""
+        try:
+            return future.result(timeout=self._device_wait_s(deadline))
+        except FutureTimeout:
+            if deadline is not None:
+                deadline.check("device")
+            if self.wedged_fallback:
+                self._record_wedge()
+                return run_plan(frame, frame_plan)
+            exc = ServiceUnavailableException(
+                "device executor did not produce a result in time"
+            )
+            raise exc from None
 
     def _tiled_or_none(self, frame: np.ndarray, plan: TransformPlan):
         """Run an H-sharded tiled program when one applies to a tall input:
@@ -427,6 +510,7 @@ class ImageHandler:
         options: OptionsBag,
         *,
         alpha,
+        deadline: Optional[Deadline] = None,
     ) -> bytes:
         """Encode a finished frame. JPEG outputs ride the native encode
         pool through the host-codec controller when available, so
@@ -461,11 +545,17 @@ class ImageHandler:
             # validate the grammar HERE so a bad sf_ raises in the request
             # thread (typed 400), not inside the shared pool runner
             sampling = parse_sampling_factor(sampling_factor)
-            blob = self.codec_batcher.submit_aux(
-                ("jpegenc", quality, sampling, mozjpeg),
-                (np.ascontiguousarray(frame), quality, sampling, mozjpeg),
-                batch_jpeg_encode,
-            ).result(timeout=self.DEVICE_RESULT_TIMEOUT_S)
+            try:
+                blob = self.codec_batcher.submit_aux(
+                    ("jpegenc", quality, sampling, mozjpeg),
+                    (np.ascontiguousarray(frame), quality, sampling, mozjpeg),
+                    batch_jpeg_encode,
+                ).result(timeout=self._device_wait_s(deadline))
+            except FutureTimeout:
+                if deadline is not None:
+                    deadline.check("encode")
+                self._record_wedge()
+                blob = None  # wedged codec pool: single-image encode below
             if blob is not None:
                 return blob
         return encode(
@@ -479,12 +569,14 @@ class ImageHandler:
             alpha=alpha,
         )
 
-    def _decode_batched(self, data: bytes, hint, info):
+    def _decode_batched(self, data: bytes, hint, info,
+                        deadline: Optional[Deadline] = None):
         """JPEG fast path through the native DecodePool: concurrent misses
         sharing a DCT prescale decode as ONE pool batch on the host-codec
         controller's thread. Returns None for everything the pool doesn't
-        cover (non-JPEG, pool unavailable, or a per-image decode failure)
-        — the caller falls back to the single-image decode()."""
+        cover (non-JPEG, pool unavailable, a per-image decode failure, or
+        a wedged pool) — the caller falls back to the single-image
+        decode()."""
         if self.codec_batcher is None:
             return None
         from flyimg_tpu.codecs import (
@@ -497,9 +589,15 @@ class ImageHandler:
         if info.mime != "image/jpeg" or native_codec.get_pool() is None:
             return None
         scale = jpeg_batch_scale_num(info, hint)
-        rgb = self.codec_batcher.submit_aux(
-            ("jpegdec", scale), (data, scale), batch_jpeg_decode
-        ).result(timeout=self.DEVICE_RESULT_TIMEOUT_S)
+        try:
+            rgb = self.codec_batcher.submit_aux(
+                ("jpegdec", scale), (data, scale), batch_jpeg_decode
+            ).result(timeout=self._device_wait_s(deadline))
+        except FutureTimeout:
+            if deadline is not None:
+                deadline.check("decode")
+            self._record_wedge()
+            return None
         if rgb is None:
             return None
         return DecodedImage(
@@ -515,10 +613,13 @@ class ImageHandler:
         options: OptionsBag,
         spec: OutputSpec,
         timings: Dict[str, float],
+        deadline: Optional[Deadline] = None,
     ) -> bytes:
         """Transform pipeline on a cache miss (reference
         ImageHandler::processNewImage, ImageHandler.php:160-181)."""
         t = time.perf_counter()
+        if deadline is not None:
+            deadline.check("decode")
 
         is_animated_gif_out = spec.is_gif
         # clsp_CMYK can only be stored in a JPEG container: refuse HERE,
@@ -532,7 +633,7 @@ class ImageHandler:
 
         gif_frame = options.int_option("gif-frame", 0) or 0
         data_info = media_info(data)  # one probe, shared by both paths
-        decoded = self._decode_batched(data, hint, data_info)
+        decoded = self._decode_batched(data, hint, data_info, deadline)
         if decoded is None:
             decoded = decode(
                 data, target_hint=hint, frame=gif_frame, info=data_info
@@ -622,18 +723,21 @@ class ImageHandler:
                 )
             tiled = self._tiled_or_none(frame, frame_plan)
             if tiled is not None:
-                staged.append(tiled)
+                staged.append((tiled, frame, frame_plan))
             elif self.batcher is not None:
                 # concurrent requests sharing a program batch into one
-                # device launch; .result() parks this worker thread while
-                # the group fills (flyimg_tpu/runtime/batcher.py)
-                staged.append(self.batcher.submit(frame, frame_plan))
+                # device launch; the deadline-aware wait below parks this
+                # worker thread while the group fills
+                # (flyimg_tpu/runtime/batcher.py)
+                staged.append(
+                    (self.batcher.submit(frame, frame_plan), frame, frame_plan)
+                )
             else:
-                staged.append(run_plan(frame, frame_plan))
+                staged.append((run_plan(frame, frame_plan), frame, frame_plan))
         out_frames = [
-            s.result(timeout=self.DEVICE_RESULT_TIMEOUT_S)
+            self._await_transform(s, frame, frame_plan, deadline)
             if isinstance(s, Future) else s
-            for s in staged
+            for s, frame, frame_plan in staged
         ]
         timings["device"] = time.perf_counter() - t
 
@@ -651,12 +755,20 @@ class ImageHandler:
                     # bench.py measures; the per-image path would recompile
                     # analyse_features for every distinct post-resize size
                     item = sc.prepare_work(out)
-                    crop = self.batcher.submit_aux(
-                        ("smc", item.bucket, item.step),
-                        item,
-                        sc.find_best_crops_batched,
-                    ).result(timeout=self.DEVICE_RESULT_TIMEOUT_S)
-                    out = sc.apply_crop(out, crop)
+                    try:
+                        crop = self.batcher.submit_aux(
+                            ("smc", item.bucket, item.step),
+                            item,
+                            sc.find_best_crops_batched,
+                        ).result(timeout=self._device_wait_s(deadline))
+                    except FutureTimeout:
+                        if deadline is not None:
+                            deadline.check("smartcrop")
+                        # wedged executor: score single-image in this thread
+                        self._record_wedge()
+                        out = sc.smart_crop_image(out)
+                    else:
+                        out = sc.apply_crop(out, crop)
                 else:
                     out = sc.smart_crop_image(out)
                 timings["smartcrop"] = time.perf_counter() - t
@@ -666,9 +778,16 @@ class ImageHandler:
                 if self.batcher is not None and hasattr(ff, "prepare_face_work"):
                     # batched detection: one mask program per shape bucket
                     item = ff.prepare_face_work(out)
-                    faces = self.batcher.submit_aux(
-                        ("face", item.bucket), item, ff.detect_faces_batched
-                    ).result(timeout=self.DEVICE_RESULT_TIMEOUT_S)
+                    try:
+                        faces = self.batcher.submit_aux(
+                            ("face", item.bucket), item,
+                            ff.detect_faces_batched,
+                        ).result(timeout=self._device_wait_s(deadline))
+                    except FutureTimeout:
+                        if deadline is not None:
+                            deadline.check("faces")
+                        self._record_wedge()
+                        faces = ff.detect_faces(out)
                 else:
                     faces = ff.detect_faces(out)
                 if plan.face_blur:
@@ -679,6 +798,8 @@ class ImageHandler:
             out_frames = [out]
 
         t = time.perf_counter()
+        if deadline is not None:
+            deadline.check("encode")
         # attach-time decision mirrors keeps_alpha (the flatten decision):
         # attaching alpha to rgb that was already flattened over bg would
         # double-composite semi-transparent pixels
@@ -704,7 +825,7 @@ class ImageHandler:
             )
         else:
             content = self._encode_one(
-                out_frames[0], spec, options, alpha=alpha
+                out_frames[0], spec, options, alpha=alpha, deadline=deadline
             )
         # st_0: the reference preserves ALL source metadata when -strip is
         # off (ImageProcessor.php:97-99) — EXIF, ICC profile, XMP. A

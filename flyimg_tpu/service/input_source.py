@@ -8,6 +8,14 @@ Reference behavior preserved (src/Core/Entity/Image/InputImage.php:76-101):
 - local filesystem paths work as "URLs" (the reference relies on PHP fopen
   accepting both; its whole test suite uses local paths).
 
+Beyond-reference resilience (runtime/resilience.py): the fetch streams the
+body and aborts the transfer the moment it exceeds ``MAX_SOURCE_BYTES``
+(the reference buffers everything first — a hostile origin could force a
+256 MB allocation per request), splits the flat timeout into
+connect/read/write components so a blackholed origin fails in seconds, and
+wraps the attempt in retry-with-jitter + a per-host circuit breaker, all
+bounded by the request's deadline budget.
+
 Video/PDF sources are swapped for an extracted frame / rasterized page
 before decoding (InputImage.php:61-68), via the gated ingestion backends.
 """
@@ -17,6 +25,7 @@ from __future__ import annotations
 import os
 import threading
 from dataclasses import dataclass
+from typing import Optional
 
 import httpx
 
@@ -24,9 +33,86 @@ from flyimg_tpu.codecs import MediaInfo, media_info
 from flyimg_tpu.codecs import pdf as pdf_codec
 from flyimg_tpu.codecs import video as video_codec
 from flyimg_tpu.exceptions import ReadFileException
+from flyimg_tpu.runtime.resilience import (
+    BreakerRegistry,
+    Deadline,
+    RetryPolicy,
+    host_of,
+)
 from flyimg_tpu.spec.options import OptionsBag
+from flyimg_tpu.testing import faults
 
 MAX_SOURCE_BYTES = 256 * 1024 * 1024
+
+# transient transport failures: worth a retry, and they count against the
+# upstream's circuit breaker. Anything else (4xx except 429, protocol-level
+# refusals, the byte cap) is deterministic and fails immediately.
+_TRANSIENT_HTTPX = (
+    httpx.ConnectError,
+    httpx.ConnectTimeout,
+    httpx.ReadTimeout,
+    httpx.WriteTimeout,
+    httpx.PoolTimeout,
+    httpx.RemoteProtocolError,
+)
+
+
+def is_transient_fetch_error(exc: BaseException) -> bool:
+    """The ONE transient-vs-deterministic classification for source
+    fetches, shared by the retry policy and the circuit breaker."""
+    if isinstance(exc, _TRANSIENT_HTTPX):
+        return True
+    if isinstance(exc, httpx.HTTPStatusError):
+        status = exc.response.status_code
+        return status == 429 or 500 <= status <= 599
+    return False
+
+
+@dataclass
+class FetchPolicy:
+    """Server-level fetch resilience wiring (one per app): split timeouts,
+    retry policy, and the per-host breaker registry. ``from_params`` reads
+    the appconfig knobs; a default-constructed policy matches them."""
+
+    connect_timeout_s: float = 3.0
+    read_timeout_s: float = 10.0
+    write_timeout_s: float = 10.0
+    retry: Optional[RetryPolicy] = None
+    breakers: Optional[BreakerRegistry] = None
+
+    def __post_init__(self) -> None:
+        if self.retry is None:
+            self.retry = RetryPolicy()
+        if self.breakers is None:
+            self.breakers = BreakerRegistry()
+
+    def httpx_timeout(self, flat_cap: Optional[float] = None) -> httpx.Timeout:
+        """Component timeouts, each additionally capped by ``flat_cap``
+        (the remaining deadline budget) when given."""
+
+        def cap(v: float) -> float:
+            return min(v, flat_cap) if flat_cap is not None else v
+
+        return httpx.Timeout(
+            connect=cap(self.connect_timeout_s),
+            read=cap(self.read_timeout_s),
+            write=cap(self.write_timeout_s),
+            pool=cap(self.connect_timeout_s),
+        )
+
+    @classmethod
+    def from_params(cls, params, *, metrics=None) -> "FetchPolicy":
+        return cls(
+            connect_timeout_s=float(
+                params.by_key("fetch_connect_timeout_s", 3.0)
+            ),
+            read_timeout_s=float(params.by_key("fetch_read_timeout_s", 10.0)),
+            write_timeout_s=float(
+                params.by_key("fetch_write_timeout_s", 10.0)
+            ),
+            retry=RetryPolicy.from_params(params, metrics=metrics),
+            breakers=BreakerRegistry.from_params(params, metrics=metrics),
+        )
 
 
 @dataclass
@@ -48,6 +134,48 @@ def _parse_extra_headers(header_extra_options: str) -> dict:
     return headers
 
 
+def _http_fetch_once(
+    image_url: str,
+    headers: dict,
+    timeout: httpx.Timeout,
+    deadline: Optional[Deadline] = None,
+) -> bytes:
+    """ONE fetch attempt, streaming the body so the transfer aborts the
+    moment it exceeds MAX_SOURCE_BYTES (instead of buffering a hostile
+    origin's response whole) and the moment the request budget dies (the
+    per-read timeout alone cannot stop a slow-drip origin that sends one
+    chunk every few seconds forever). The retry/breaker wrappers live in
+    fetch_original; injected faults fire here so they are subject to both."""
+    injected = faults.fire("fetch.http", url=image_url)
+    if injected is not faults.PASS:
+        return injected
+    with httpx.stream(
+        "GET",
+        image_url,
+        headers=headers,
+        timeout=timeout,
+        follow_redirects=False,  # reference: max_redirects 0
+    ) as resp:
+        resp.raise_for_status()
+        length = resp.headers.get("Content-Length")
+        if length and length.isdigit() and int(length) > MAX_SOURCE_BYTES:
+            raise ReadFileException(
+                f"source exceeds {MAX_SOURCE_BYTES} bytes"
+            )
+        chunks = []
+        total = 0
+        for chunk in resp.iter_bytes():
+            if deadline is not None:
+                deadline.check("fetch")
+            total += len(chunk)
+            if total > MAX_SOURCE_BYTES:
+                raise ReadFileException(
+                    f"source exceeds {MAX_SOURCE_BYTES} bytes"
+                )
+            chunks.append(chunk)
+        return b"".join(chunks)
+
+
 def fetch_original(
     image_url: str,
     tmp_dir: str,
@@ -55,14 +183,23 @@ def fetch_original(
     refresh: bool = False,
     header_extra_options: str = "",
     timeout: float = 30.0,
+    policy: Optional[FetchPolicy] = None,
+    deadline: Optional[Deadline] = None,
 ) -> str:
-    """Fetch (or reuse) the original source; returns its cache path."""
+    """Fetch (or reuse) the original source; returns its cache path.
+
+    ``timeout`` keeps the legacy flat-cap meaning for direct callers; with
+    a ``policy`` the connect/read/write components apply (each further
+    capped by the remaining ``deadline`` budget). Transient failures retry
+    with jittered backoff and feed the per-host circuit breaker."""
     os.makedirs(tmp_dir, exist_ok=True)
     cache_path = os.path.join(
         tmp_dir, OptionsBag.hash_original_image_url(image_url)
     )
     if os.path.exists(cache_path) and not refresh:
         return cache_path
+    if deadline is not None:
+        deadline.check("fetch")
 
     if "://" not in image_url:
         # local path "URL" (reference tests use these throughout)
@@ -70,22 +207,60 @@ def fetch_original(
             raise ReadFileException(f"Unable to read file: {image_url}")
         with open(image_url, "rb") as fh:
             data = fh.read(MAX_SOURCE_BYTES + 1)
-    else:
-        try:
-            resp = httpx.get(
-                image_url,
-                headers=_parse_extra_headers(header_extra_options),
-                timeout=timeout,
-                follow_redirects=False,  # reference: max_redirects 0
+        if len(data) > MAX_SOURCE_BYTES:
+            raise ReadFileException(
+                f"source exceeds {MAX_SOURCE_BYTES} bytes"
             )
-            resp.raise_for_status()
-            data = resp.content
+    else:
+        policy = policy if policy is not None else FetchPolicy()
+        headers = _parse_extra_headers(header_extra_options)
+        breaker = policy.breakers.for_host(host_of(image_url))
+
+        def attempt() -> bytes:
+            # everything that can fail WITHOUT an actual fetch attempt
+            # (deadline exhaustion, timeout math) happens before
+            # breaker.allow(): an admitted half-open probe slot must
+            # always reach the record_* below or it would leak and wedge
+            # the breaker half-open forever
+            flat = None
+            if deadline is not None:
+                deadline.check("fetch")
+                rem = deadline.remaining()
+                flat = rem if rem != float("inf") else timeout
+            elif timeout:
+                flat = timeout
+            httpx_timeout = policy.httpx_timeout(flat)
+            # the breaker gates EVERY attempt (retries included): a host
+            # that just tripped open must not be hammered by the tail of
+            # an in-flight retry loop
+            breaker.allow()
+            # BaseException-wide accounting: an admitted (possibly
+            # half-open-probe) attempt must ALWAYS reach a record_* call,
+            # or the probe slot leaks and the breaker wedges half-open
+            try:
+                data = _http_fetch_once(
+                    image_url, headers, httpx_timeout, deadline
+                )
+            except BaseException as exc:
+                if is_transient_fetch_error(exc):
+                    breaker.record_failure()
+                else:
+                    breaker.record_success()  # origin answered; not "down"
+                raise
+            breaker.record_success()
+            return data
+
+        try:
+            data = policy.retry.run(
+                attempt,
+                retryable=is_transient_fetch_error,
+                deadline=deadline,
+                point="fetch",
+            )
         except httpx.HTTPError as exc:
             raise ReadFileException(
                 f"Unable to fetch source image: {image_url}: {exc}"
             ) from exc
-    if len(data) > MAX_SOURCE_BYTES:
-        raise ReadFileException(f"source exceeds {MAX_SOURCE_BYTES} bytes")
 
     # unique temp per writer: concurrent fetches of the same URL must not
     # share a .part file (the loser's os.replace would find it gone); the
@@ -110,6 +285,8 @@ def load_source(
     tmp_dir: str,
     *,
     header_extra_options: str = "",
+    policy: Optional[FetchPolicy] = None,
+    deadline: Optional[Deadline] = None,
 ) -> InputSource:
     """Fetch + ingest a source: videos become a frame at tm_, PDFs become a
     rasterized page at pg_/dnst_. Frames/pages are cached per parameter,
@@ -119,6 +296,7 @@ def load_source(
     cache_path = fetch_original(
         image_url, tmp_dir, refresh=refresh,
         header_extra_options=header_extra_options,
+        policy=policy, deadline=deadline,
     )
     with open(cache_path, "rb") as fh:
         head = fh.read(65536)

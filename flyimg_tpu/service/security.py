@@ -14,7 +14,11 @@ import hashlib
 from typing import List, Tuple
 from urllib.parse import urlparse
 
-from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+try:  # gated: signed URLs are off by default (empty security_key), and a
+    # container without `cryptography` must still serve unsigned traffic
+    from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+except ImportError:  # pragma: no cover - depends on the host image
+    Cipher = algorithms = modes = None
 
 from flyimg_tpu.exceptions import SecurityException
 
@@ -26,6 +30,11 @@ def _derive(security_key: str, security_iv: str) -> Tuple[bytes, bytes]:
     (SecurityHandler.php:120-137)."""
     if not security_key:
         raise SecurityException("security_key is empty in parameters")
+    if Cipher is None:
+        raise SecurityException(
+            "signed URLs require the `cryptography` package, which is not "
+            "installed"
+        )
     key_hex = hashlib.sha256(security_key.encode()).hexdigest()
     iv_hex = hashlib.sha256(security_iv.encode()).hexdigest()[:16]
     return key_hex[:32].encode("ascii"), iv_hex.encode("ascii")

@@ -10,16 +10,22 @@ from flyimg_tpu.storage.base import Storage  # noqa: F401
 from flyimg_tpu.storage.local import LocalStorage  # noqa: F401
 
 
-def make_storage(params) -> "Storage":
+def make_storage(params, metrics=None) -> "Storage":
     """Select the backend by the ``storage_system`` server param
-    (reference app.php:54-62)."""
+    (reference app.php:54-62) and arm its transient-failure retry policy
+    (runtime/resilience.py; knobs shared with source fetching)."""
+    from flyimg_tpu.runtime.resilience import RetryPolicy
+
     system = params.by_key("storage_system", "local")
     if system == "s3":
         from flyimg_tpu.storage.s3 import S3Storage
 
-        return S3Storage(params)
-    if system == "gcs":
+        storage: Storage = S3Storage(params)
+    elif system == "gcs":
         from flyimg_tpu.storage.gcs import GCSStorage
 
-        return GCSStorage(params)
-    return LocalStorage(params)
+        storage = GCSStorage(params)
+    else:
+        storage = LocalStorage(params)
+    storage.retry_policy = RetryPolicy.from_params(params, metrics=metrics)
+    return storage

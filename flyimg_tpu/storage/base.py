@@ -18,6 +18,37 @@ class StorageStat:
 
 
 class Storage(abc.ABC):
+    #: optional runtime.resilience.RetryPolicy installed by make_storage;
+    #: backends route reads/writes through _with_retry so transient backend
+    #: hiccups (throttling, 5xx, EIO) retry with jittered backoff instead
+    #: of failing the request
+    retry_policy = None
+
+    @staticmethod
+    def _is_transient(exc: Exception) -> bool:
+        """Backend-specific transient classification; the default retries
+        nothing (safe for unknown backends)."""
+        return False
+
+    def _with_retry(self, op: str, fn):
+        """Run one storage operation under the retry policy (when set) and
+        the ``storage.<op>`` fault-injection point. Injected plans may
+        raise (simulated backend failure, subject to the same retry
+        classification) or return a value (simulated success)."""
+        from flyimg_tpu.testing import faults
+
+        def attempt():
+            injected = faults.fire(f"storage.{op}")
+            if injected is not faults.PASS:
+                return injected
+            return fn()
+
+        if self.retry_policy is None:
+            return attempt()
+        return self.retry_policy.run(
+            attempt, retryable=self._is_transient, point=f"storage.{op}"
+        )
+
     @abc.abstractmethod
     def has(self, name: str) -> bool: ...
 

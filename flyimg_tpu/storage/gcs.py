@@ -39,6 +39,17 @@ class GCSStorage(Storage):
         self._bucket = self._client.bucket(self.bucket_name)
 
     @staticmethod
+    def _is_transient(exc: Exception) -> bool:
+        """google-api-core's own retryable set, duck-typed on ``code``
+        (429 throttling + 5xx server errors), plus transport-level
+        connection failures / timeouts (requests/urllib3 raise these with
+        no ``code``), name-stem-matched like the S3 classifier."""
+        if getattr(exc, "code", None) in (429, 500, 502, 503, 504):
+            return True
+        name = type(exc).__name__
+        return "ConnectionError" in name or "Timeout" in name
+
+    @staticmethod
     def _is_not_found(exc: Exception) -> bool:
         """Missing objects only (404); outages AND permission errors must
         propagate (a miss triggers recompute+rewrite, so an error misread
@@ -57,15 +68,22 @@ class GCSStorage(Storage):
             raise
 
     def read(self, name: str) -> bytes:
-        return self._bucket.blob(name).download_as_bytes()
+        return self._with_retry(
+            "read", lambda: self._bucket.blob(name).download_as_bytes()
+        )
 
     def write(self, name: str, data: bytes) -> Optional[float]:
-        blob = self._bucket.blob(name)
-        blob.upload_from_string(data)
-        # upload_from_string refreshes blob metadata from the response:
-        # the object's OWN stamp, so hits serve the identical validator
-        updated = getattr(blob, "updated", None)
-        return updated.timestamp() if updated is not None else time.time()
+        def _write():
+            blob = self._bucket.blob(name)
+            blob.upload_from_string(data)
+            # upload_from_string refreshes blob metadata from the response:
+            # the object's OWN stamp, so hits serve the identical validator
+            updated = getattr(blob, "updated", None)
+            return (
+                updated.timestamp() if updated is not None else time.time()
+            )
+
+        return self._with_retry("write", _write)
 
     def delete(self, name: str) -> None:
         try:

@@ -6,6 +6,7 @@ request's scheme://host, with the '/uploads/%s' web path
 
 from __future__ import annotations
 
+import errno
 import os
 from typing import Optional
 
@@ -13,11 +14,23 @@ from flyimg_tpu.storage.base import Storage, StorageStat
 
 UPLOAD_WEB_DIR = "uploads/"
 
+# local-disk errnos worth a retry: transient I/O pressure, not a missing
+# file or a permission problem
+_TRANSIENT_ERRNOS = frozenset(
+    {errno.EIO, errno.EAGAIN, errno.EBUSY, errno.EINTR, errno.ENOSPC}
+)
+
 
 class LocalStorage(Storage):
     def __init__(self, params) -> None:
         self.root = os.path.abspath(params.by_key("upload_dir", "web/uploads"))
         os.makedirs(self.root, exist_ok=True)
+
+    @staticmethod
+    def _is_transient(exc: Exception) -> bool:
+        return (
+            isinstance(exc, OSError) and exc.errno in _TRANSIENT_ERRNOS
+        )
 
     def _path(self, name: str) -> str:
         # content-addressed names are md5 hex + extension; never trust them
@@ -29,22 +42,28 @@ class LocalStorage(Storage):
         return os.path.exists(self._path(name))
 
     def read(self, name: str) -> bytes:
-        with open(self._path(name), "rb") as fh:
-            return fh.read()
+        def _read():
+            with open(self._path(name), "rb") as fh:
+                return fh.read()
+
+        return self._with_retry("read", _read)
 
     def write(self, name: str, data: bytes):
-        path = self._path(name)
-        tmp = path + ".part"
-        with open(tmp, "wb") as fh:
-            fh.write(data)
-        # atomic publish: concurrent same-key writers race benignly
-        # (last-write-wins, like the reference's Flysystem write;
-        # SURVEY.md section 5 'race detection')
-        os.replace(tmp, path)
-        try:
-            return os.path.getmtime(path)
-        except OSError:
-            return None
+        def _write():
+            path = self._path(name)
+            tmp = path + ".part"
+            with open(tmp, "wb") as fh:
+                fh.write(data)
+            # atomic publish: concurrent same-key writers race benignly
+            # (last-write-wins, like the reference's Flysystem write;
+            # SURVEY.md section 5 'race detection')
+            os.replace(tmp, path)
+            try:
+                return os.path.getmtime(path)
+            except OSError:
+                return None
+
+        return self._with_retry("write", _write)
 
     def delete(self, name: str) -> None:
         try:
@@ -59,13 +78,16 @@ class LocalStorage(Storage):
             return None
 
     def fetch(self, name: str):
-        try:
+        def _fetch():
             with open(self._path(name), "rb") as fh:
                 data = fh.read()
                 mtime = os.fstat(fh.fileno()).st_mtime
+            return data, StorageStat(mtime=mtime)
+
+        try:
+            return self._with_retry("fetch", _fetch)
         except OSError:
             return None
-        return data, StorageStat(mtime=mtime)
 
     def public_url(self, name: str, request_base: Optional[str] = None) -> str:
         base = os.environ.get("HOSTNAME_URL") or request_base or ""

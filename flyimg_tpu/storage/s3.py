@@ -48,6 +48,27 @@ class S3Storage(Storage):
             return str(response.get("Error", {}).get("Code", ""))
         return ""
 
+    # retryable S3 answers: throttling + internal errors (AWS's own SDK
+    # retry classification, duck-typed on the error code so the boto3
+    # import stays gated) and transport-level connection failures
+    _TRANSIENT_CODES = frozenset(
+        {
+            "SlowDown", "Throttling", "ThrottlingException",
+            "RequestTimeout", "RequestTimeoutException", "InternalError",
+            "ServiceUnavailable", "500", "502", "503", "504",
+        }
+    )
+
+    @classmethod
+    def _is_transient(cls, exc: Exception) -> bool:
+        if cls._error_code(exc) in cls._TRANSIENT_CODES:
+            return True
+        # botocore transport errors (EndpointConnectionError,
+        # ConnectionClosedError, ReadTimeoutError...) share these name
+        # stems; duck-typed like _error_code
+        name = type(exc).__name__
+        return "ConnectionError" in name or "Timeout" in name
+
     @classmethod
     def _is_not_found(cls, exc: Exception) -> bool:
         """Only genuine not-found responses mean "cache miss". Anything
@@ -77,11 +98,19 @@ class S3Storage(Storage):
             raise
 
     def read(self, name: str) -> bytes:
-        obj = self._client.get_object(Bucket=self.bucket, Key=name)
-        return obj["Body"].read()
+        def _read():
+            obj = self._client.get_object(Bucket=self.bucket, Key=name)
+            return obj["Body"].read()
+
+        return self._with_retry("read", _read)
 
     def write(self, name: str, data: bytes) -> Optional[float]:
-        self._client.put_object(Bucket=self.bucket, Key=name, Body=data)
+        self._with_retry(
+            "write",
+            lambda: self._client.put_object(
+                Bucket=self.bucket, Key=name, Body=data
+            ),
+        )
         # PutObject returns no LastModified; read back the object's OWN
         # stamp so the miss response and every later cache hit serve the
         # IDENTICAL validator (Date-header/local-clock approximations can
@@ -110,7 +139,12 @@ class S3Storage(Storage):
 
     def fetch(self, name: str):
         try:
-            obj = self._client.get_object(Bucket=self.bucket, Key=name)
+            obj = self._with_retry(
+                "fetch",
+                lambda: self._client.get_object(
+                    Bucket=self.bucket, Key=name
+                ),
+            )
         except Exception as exc:
             if self._is_not_found(exc):
                 code = self._error_code(exc)

@@ -1,0 +1,3 @@
+"""Test-support subpackage: deterministic fault injection for the serving
+pipeline (flyimg_tpu.testing.faults). Nothing here runs in production
+unless an operator explicitly installs an injector via app config."""

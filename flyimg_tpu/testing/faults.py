@@ -1,0 +1,152 @@
+"""Deterministic fault injection for the serving pipeline.
+
+Resilience behavior (retries, breakers, deadlines, load shedding) cannot be
+proven with real network or device flakiness — tests need faults that fire
+exactly N times, at exactly one pipeline point, and then stop. This module
+provides that as named *injection points* the pipeline fires on its way
+through:
+
+    ``fetch.http``      one HTTP fetch attempt (service/input_source.py);
+                        an injected plan may raise (simulated transport
+                        failure) or return body bytes (simulated success)
+    ``storage.read``    one storage fetch/read attempt
+    ``storage.write``   one storage write attempt
+    ``batcher.execute`` the batch executor about to run a group — a
+                        blocking plan wedges the device executor
+
+Production cost is one module-level ``None`` check per point (no injector
+installed -> ``fire`` returns ``PASS`` immediately). Tests install a
+``FaultInjector`` either directly (``install``/``clear``) or through the
+app-config hook: ``make_app`` installs whatever object sits under the
+``fault_injector`` parameter, so an HTTP-level test can inject faults into
+a fully assembled app without monkeypatching internals.
+
+All plans are deterministic scripts — ``fail_n_then_succeed``, fixed
+latency spikes, an Event-gated wedge — never random.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+__all__ = [
+    "PASS",
+    "FaultInjector",
+    "install",
+    "clear",
+    "fire",
+    "fail_n_then_succeed",
+    "latency_spike",
+    "wedge_until",
+]
+
+#: sentinel: "no plan fired — run the real code path"
+PASS = object()
+
+
+class FaultInjector:
+    """A set of scripted fault plans keyed by injection point.
+
+    A plan is ``callable(**ctx) -> value | PASS`` and may raise. ``value``
+    short-circuits the real code path (simulated success); ``PASS`` falls
+    through to it; an exception is the injected fault. Plans fire on every
+    hit of their point until removed — determinism lives inside the plan
+    (e.g. a fail-counter), not in the harness.
+    """
+
+    def __init__(self) -> None:
+        self._plans: Dict[str, Callable] = {}
+        self._lock = threading.Lock()
+        self.fired: Dict[str, int] = {}
+
+    def plan(self, point: str, fn: Callable) -> "FaultInjector":
+        with self._lock:
+            self._plans[point] = fn
+        return self
+
+    def remove(self, point: str) -> None:
+        with self._lock:
+            self._plans.pop(point, None)
+
+    def fire(self, point: str, **ctx):
+        with self._lock:
+            fn = self._plans.get(point)
+            if fn is None:
+                return PASS
+            self.fired[point] = self.fired.get(point, 0) + 1
+        return fn(**ctx)
+
+
+_active: Optional[FaultInjector] = None
+
+
+def install(injector: FaultInjector) -> FaultInjector:
+    """Install ``injector`` process-wide (tests: pair with ``clear`` in a
+    finally block, or use the ``fault_injector`` app param)."""
+    global _active
+    _active = injector
+    return injector
+
+
+def clear() -> None:
+    global _active
+    _active = None
+
+
+def fire(point: str, **ctx):
+    """Called by the pipeline at each injection point. Returns ``PASS``
+    (run the real code) or an injected value; raises injected faults."""
+    if _active is None:
+        return PASS
+    return _active.fire(point, **ctx)
+
+
+# ---------------------------------------------------------------------------
+# canned deterministic plans
+
+
+def fail_n_then_succeed(n: int, exc_factory: Callable[[], BaseException],
+                        result=PASS) -> Callable:
+    """Raise ``exc_factory()`` for the first ``n`` hits, then return
+    ``result`` (default ``PASS`` — fall through to the real path)."""
+    remaining = [n]
+    lock = threading.Lock()
+
+    def plan(**_ctx):
+        with lock:
+            if remaining[0] > 0:
+                remaining[0] -= 1
+                raise exc_factory()
+        return result
+
+    return plan
+
+
+def latency_spike(seconds: float, then=PASS) -> Callable:
+    """Sleep ``seconds`` on every hit, then return ``then`` (default:
+    fall through; an exception instance/class is raised instead). Models
+    a slow upstream/stage — slow-then-alive or slow-then-dead."""
+
+    def plan(**_ctx):
+        time.sleep(seconds)
+        if isinstance(then, BaseException) or (
+            isinstance(then, type) and issubclass(then, BaseException)
+        ):
+            raise then
+        return then
+
+    return plan
+
+
+def wedge_until(event: threading.Event, timeout_s: float = 30.0) -> Callable:
+    """Block until the test sets ``event`` (bounded by ``timeout_s`` so an
+    aborted test cannot wedge the suite). Installed at ``batcher.execute``
+    this freezes the device executor thread — the wedged-executor scenario."""
+
+    def plan(**_ctx):
+        event.wait(timeout=timeout_s)
+        return PASS
+
+    return plan

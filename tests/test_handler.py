@@ -266,12 +266,12 @@ def test_concurrent_misses_coalesce_to_one_pipeline(env, monkeypatch):
     barrier = threading.Barrier(4, timeout=10)
     real = handler._process_new
 
-    def slow_process(data, options, spec, timings):
+    def slow_process(data, options, spec, timings, **kwargs):
         calls.append(1)
         import time as _t
 
         _t.sleep(0.2)  # hold the leader open so followers pile up
-        return real(data, options, spec, timings)
+        return real(data, options, spec, timings, **kwargs)
 
     monkeypatch.setattr(handler, "_process_new", slow_process)
 
@@ -557,7 +557,7 @@ def test_singleflight_follower_timeout_returns_503_class(env):
 
     handler, _, tmp = env
     src = _write_png(tmp / "sf.png")
-    handler.DEVICE_RESULT_TIMEOUT_S = 0.2
+    handler.device_result_timeout_s = 0.2
     handler._singleflight.begin = lambda key: (False, Future())
     with pytest.raises(ServiceUnavailableException):
         handler.process_image("w_30,o_png", src)

@@ -190,6 +190,49 @@ def test_invalid_output_extension_400(tmp_path, source_png):
     assert b"InvalidArgumentException" in body
 
 
+def test_resilience_error_status_mapping(tmp_path):
+    """DeadlineExceededException -> 504; ServiceUnavailableException ->
+    503 carrying Retry-After from the exception's retry_after_s
+    (runtime/resilience.py admission/breaker shed)."""
+    from flyimg_tpu.exceptions import (
+        DeadlineExceededException,
+        ServiceUnavailableException,
+    )
+    from flyimg_tpu.service.app import HANDLER_KEY
+
+    def hit_with(exc):
+        async def go():
+            app = make_app(_params(tmp_path))
+            app[HANDLER_KEY].process_image = (
+                lambda *a, **k: (_ for _ in ()).throw(exc)
+            )
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                resp = await client.get("/upload/w_20/ignored.png")
+                return resp.status, dict(resp.headers), await resp.text()
+            finally:
+                await client.close()
+
+        return _run(go())
+
+    status, headers, body = hit_with(DeadlineExceededException("budget"))
+    assert status == 504
+    assert "DeadlineExceededException" in body
+    assert "Retry-After" not in headers  # 504 is not an invitation to hammer
+
+    shed = ServiceUnavailableException("queue full")
+    shed.retry_after_s = 5
+    status, headers, body = hit_with(shed)
+    assert status == 503
+    assert headers["Retry-After"] == "5"
+    assert "ServiceUnavailableException" in body
+
+    # the class default applies when nothing set a specific value
+    status, headers, _ = hit_with(ServiceUnavailableException("wedged"))
+    assert status == 503 and headers["Retry-After"] == "1"
+
+
 def test_restricted_domain_403(tmp_path):
     status, _, body = _request(
         tmp_path,
@@ -206,6 +249,7 @@ def test_restricted_domain_403(tmp_path):
 def test_signed_url_flow(tmp_path, source_png):
     """With a security key set, the options segment carries the encrypted
     '{options}/{imageSrc}' token (reference SecurityHandler.php:58-88)."""
+    pytest.importorskip("cryptography")
     from flyimg_tpu.service.security import encrypt
 
     key, iv = "test-key", "test-iv"

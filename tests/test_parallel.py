@@ -330,6 +330,9 @@ def test_ensure_live_backend_falls_back_when_probe_fails(monkeypatch):
     monkeypatch.setattr(subprocess, "Popen", FakeProc)
     monkeypatch.setattr(mesh_mod, "force_cpu_platform",
                         lambda n=1: forced.append(n))
+    # this test exercises the PROBE path; on hosts with no accelerator
+    # plugin at all the static check would short-circuit to "cpu"
+    monkeypatch.setattr(mesh_mod, "_noncpu_plugin_available", lambda: True)
     monkeypatch.delenv("JAX_PLATFORMS", raising=False)
     monkeypatch.setenv("XLA_FLAGS", "")
     assert mesh_mod.ensure_live_backend(5.0) == "cpu-fallback"

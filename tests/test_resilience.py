@@ -1,0 +1,809 @@
+"""Resilience-layer tests: deadlines, retry/backoff, circuit breaking,
+admission control, wedged-executor fallback — all driven by the
+deterministic fault-injection harness (flyimg_tpu/testing/faults.py), no
+real network or device flakiness involved.
+
+Acceptance behaviors pinned here (ISSUE 1):
+- a fetch that fails twice then succeeds completes within budget,
+- an open breaker rejects in < 1 ms,
+- a full batcher queue returns 503 with Retry-After,
+- an exhausted deadline returns 504 without waiting out the remaining
+  stage timeouts.
+"""
+
+import asyncio
+import threading
+import time
+
+import httpx
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from flyimg_tpu.appconfig import AppParameters
+from flyimg_tpu.codecs import encode
+from flyimg_tpu.exceptions import (
+    DeadlineExceededException,
+    ReadFileException,
+    ServiceUnavailableException,
+)
+from flyimg_tpu.runtime.batcher import BatchController
+from flyimg_tpu.runtime.metrics import MetricsRegistry
+from flyimg_tpu.runtime.resilience import (
+    BreakerRegistry,
+    CircuitBreaker,
+    CircuitOpenException,
+    Deadline,
+    RetryPolicy,
+)
+from flyimg_tpu.service.input_source import (
+    FetchPolicy,
+    fetch_original,
+    is_transient_fetch_error,
+)
+from flyimg_tpu.testing import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.clear()
+
+
+def _png_bytes(w=40, h=30, seed=3) -> bytes:
+    rng = np.random.default_rng(seed)
+    return encode(
+        rng.integers(0, 255, (h, w, 3), dtype=np.uint8), "png"
+    )
+
+
+def _no_sleep_policy(**over) -> RetryPolicy:
+    kw = dict(max_attempts=3, base_backoff_s=0.001, max_backoff_s=0.002,
+              sleep=lambda _s: None)
+    kw.update(over)
+    return RetryPolicy(**kw)
+
+
+# ---------------------------------------------------------------------------
+# Deadline
+
+
+def test_deadline_budget_and_expiry():
+    d = Deadline(0.05)
+    assert not d.expired
+    assert 0.0 < d.remaining() <= 0.05
+    assert d.timeout(10.0) <= 0.05  # stage caps never exceed the budget
+    time.sleep(0.06)
+    assert d.expired
+    assert d.remaining() == 0.0
+    with pytest.raises(DeadlineExceededException):
+        d.check("fetch")
+
+
+def test_deadline_unbounded_noop():
+    d = Deadline(None)
+    assert not d.expired
+    assert d.remaining() == float("inf")
+    assert d.timeout(7.0) == 7.0
+    assert d.timeout(None) is None
+    d.check("anything")  # never raises
+
+
+def test_deadline_hits_are_counted():
+    metrics = MetricsRegistry()
+    d = Deadline(0.0001, metrics=metrics)
+    time.sleep(0.001)
+    with pytest.raises(DeadlineExceededException):
+        d.check("decode")
+    assert (
+        metrics.summary()['flyimg_deadline_exceeded_total{stage="decode"}']
+        == 1
+    )
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+
+
+def test_retry_fail_n_then_succeed():
+    calls = []
+    plan = faults.fail_n_then_succeed(2, lambda: OSError("transient"),
+                                      result="ok")
+
+    def fn():
+        calls.append(1)
+        return plan()
+
+    policy = _no_sleep_policy()
+    out = policy.run(fn, retryable=lambda e: isinstance(e, OSError))
+    assert out == "ok" and len(calls) == 3
+
+
+def test_retry_gives_up_after_max_attempts():
+    policy = _no_sleep_policy(max_attempts=3)
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise OSError("always")
+
+    with pytest.raises(OSError):
+        policy.run(fn, retryable=lambda e: True)
+    assert len(calls) == 3
+
+
+def test_retry_does_not_retry_deterministic_errors():
+    policy = _no_sleep_policy()
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise ValueError("deterministic")
+
+    with pytest.raises(ValueError):
+        policy.run(fn, retryable=lambda e: isinstance(e, OSError))
+    assert len(calls) == 1
+
+
+def test_retry_backoff_full_jitter_capped():
+    # rng pinned to 1.0 -> delay == min(max, base * 2^attempt) exactly
+    policy = RetryPolicy(
+        max_attempts=10, base_backoff_s=0.1, max_backoff_s=0.5,
+        rng=lambda: 1.0,
+    )
+    assert policy.backoff(1) == pytest.approx(0.2)
+    assert policy.backoff(2) == pytest.approx(0.4)
+    assert policy.backoff(3) == pytest.approx(0.5)   # cap
+    assert policy.backoff(8) == pytest.approx(0.5)   # stays capped
+    # full jitter: rng scales the cap down to zero
+    policy_low = RetryPolicy(base_backoff_s=0.1, rng=lambda: 0.0)
+    assert policy_low.backoff(1) == 0.0
+
+
+def test_retry_never_sleeps_past_deadline():
+    slept = []
+    policy = RetryPolicy(
+        max_attempts=5, base_backoff_s=10.0, max_backoff_s=10.0,
+        rng=lambda: 1.0, sleep=lambda s: slept.append(s),
+    )
+    deadline = Deadline(0.05)
+
+    def fn():
+        raise OSError("transient")
+
+    # the 10s backoff cannot fit in the 50ms budget: the real error
+    # surfaces immediately instead of burning the budget asleep
+    with pytest.raises(OSError):
+        policy.run(fn, retryable=lambda e: True, deadline=deadline)
+    assert slept == []
+    assert not deadline.expired
+
+
+def test_retries_are_counted():
+    metrics = MetricsRegistry()
+    policy = _no_sleep_policy(metrics=metrics)
+    plan = faults.fail_n_then_succeed(2, lambda: OSError("t"), result="ok")
+    policy.run(lambda: plan(), retryable=lambda e: True, point="fetch")
+    assert metrics.summary()['flyimg_retries_total{point="fetch"}'] == 2
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker
+
+
+def test_breaker_opens_after_threshold_and_rejects_fast():
+    breaker = CircuitBreaker(failure_threshold=3, recovery_s=60.0)
+    for _ in range(3):
+        breaker.allow()
+        breaker.record_failure()
+    assert breaker.state == CircuitBreaker.OPEN
+    t0 = time.perf_counter()
+    with pytest.raises(CircuitOpenException):
+        breaker.allow()
+    # the whole point: shedding costs microseconds, not a connect timeout
+    assert time.perf_counter() - t0 < 0.001
+    # CircuitOpenException is a 503 with client backoff advice
+    assert issubclass(CircuitOpenException, ServiceUnavailableException)
+
+
+def test_breaker_half_open_probe_and_close():
+    clock = [0.0]
+    breaker = CircuitBreaker(
+        failure_threshold=1, recovery_s=10.0, clock=lambda: clock[0]
+    )
+    breaker.record_failure()
+    assert breaker.state == CircuitBreaker.OPEN
+    clock[0] = 10.1  # recovery window elapsed -> half-open, ONE probe
+    breaker.allow()
+    assert breaker.state == CircuitBreaker.HALF_OPEN
+    with pytest.raises(CircuitOpenException):
+        breaker.allow()  # second concurrent probe sheds
+    breaker.record_success()
+    assert breaker.state == CircuitBreaker.CLOSED
+    breaker.allow()  # closed again: flows freely
+
+
+def test_breaker_half_open_failure_reopens():
+    clock = [0.0]
+    breaker = CircuitBreaker(
+        failure_threshold=1, recovery_s=5.0, clock=lambda: clock[0]
+    )
+    breaker.record_failure()
+    clock[0] = 5.1
+    breaker.allow()  # probe admitted
+    breaker.record_failure()  # probe failed
+    assert breaker.state == CircuitBreaker.OPEN
+    clock[0] = 5.2  # fresh window: still shedding
+    with pytest.raises(CircuitOpenException):
+        breaker.allow()
+
+
+def test_breaker_success_resets_failure_streak():
+    breaker = CircuitBreaker(failure_threshold=3)
+    breaker.record_failure()
+    breaker.record_failure()
+    breaker.record_success()  # streak broken
+    breaker.record_failure()
+    breaker.record_failure()
+    assert breaker.state == CircuitBreaker.CLOSED
+
+
+def test_breaker_registry_bounds_host_cardinality():
+    """Hostnames are client-controlled: past max_hosts the registry evicts
+    idle closed breakers (or shares an overflow breaker), so a
+    hostname-cycling client cannot grow memory/metrics without bound."""
+    reg = BreakerRegistry(failure_threshold=1, max_hosts=3)
+    tripped = reg.for_host("down.example.com")
+    tripped.record_failure()  # OPEN: must never be evicted
+    for i in range(20):
+        reg.for_host(f"h{i}.example.com")
+    assert len(reg._breakers) <= 3
+    assert reg.for_host("down.example.com") is tripped
+    assert tripped.state == CircuitBreaker.OPEN
+
+
+def test_host_of_strips_userinfo_and_lowercases():
+    from flyimg_tpu.runtime.resilience import host_of
+
+    assert host_of('http://a"b@Host.Example.com/x') == "host.example.com"
+    assert host_of("http://h.example.com:8080/x") == "h.example.com:8080"
+    assert host_of("/local/path.png") == "local"
+
+
+def test_breaker_metric_label_escapes_quotes():
+    metrics = MetricsRegistry()
+    metrics.record_breaker('evil"} bad', "open")
+    rendered = metrics.render_prometheus()
+    assert 'host="evil\\"} bad"' in rendered
+
+
+def test_half_open_probe_slot_not_leaked_by_deadline(tmp_path):
+    """A deadline that dies between breaker admission points must not
+    strand the half-open probe slot (which would wedge the breaker
+    half-open, shedding the host forever)."""
+    faults.install(faults.FaultInjector()).plan(
+        "fetch.http",
+        faults.fail_n_then_succeed(
+            1, lambda: httpx.ConnectTimeout("down"), result=_png_bytes()
+        ),
+    )
+    breakers = BreakerRegistry(failure_threshold=1, recovery_s=0.0)
+    policy = FetchPolicy(
+        retry=_no_sleep_policy(max_attempts=1), breakers=breakers
+    )
+    # trip the breaker open; recovery_s=0 puts it half-open immediately
+    with pytest.raises(ReadFileException):
+        fetch_original(
+            "http://flaky.example.com/a.png", str(tmp_path), policy=policy
+        )
+    # an already-expired deadline fails BEFORE the probe slot is taken...
+    with pytest.raises(DeadlineExceededException):
+        fetch_original(
+            "http://flaky.example.com/b.png", str(tmp_path),
+            policy=policy, deadline=Deadline(1e-9),
+        )
+    # ...so the next healthy request gets the probe and closes the breaker
+    ok = fetch_original(
+        "http://flaky.example.com/c.png", str(tmp_path), policy=policy
+    )
+    assert ok
+    breaker = breakers.for_host("flaky.example.com")
+    assert breaker.state == CircuitBreaker.CLOSED
+
+
+def test_breaker_registry_per_host_and_transitions_counted():
+    metrics = MetricsRegistry()
+    reg = BreakerRegistry(failure_threshold=1, metrics=metrics)
+    a = reg.for_host("a.example.com")
+    b = reg.for_host("b.example.com")
+    assert a is reg.for_host("a.example.com") and a is not b
+    a.record_failure()
+    assert a.state == CircuitBreaker.OPEN
+    assert b.state == CircuitBreaker.CLOSED  # isolation between hosts
+    key = 'flyimg_breaker_transitions_total{host="a.example.com",to="open"}'
+    assert metrics.summary()[key] == 1
+
+
+# ---------------------------------------------------------------------------
+# Fetch path: retry + breaker + streaming cap through fault injection
+
+
+def test_fetch_fails_twice_then_succeeds_within_budget(tmp_path):
+    body = _png_bytes()
+    faults.install(faults.FaultInjector()).plan(
+        "fetch.http",
+        faults.fail_n_then_succeed(
+            2, lambda: httpx.ConnectTimeout("boom"), result=body
+        ),
+    )
+    policy = FetchPolicy(retry=_no_sleep_policy())
+    deadline = Deadline(5.0)
+    path = fetch_original(
+        "http://origin.example.com/img.png", str(tmp_path),
+        policy=policy, deadline=deadline,
+    )
+    with open(path, "rb") as fh:
+        assert fh.read() == body
+    assert not deadline.expired
+
+
+def test_fetch_deterministic_http_error_no_retry(tmp_path):
+    calls = []
+
+    def plan(**_ctx):
+        calls.append(1)
+        req = httpx.Request("GET", "http://o.example.com/x.png")
+        resp = httpx.Response(404, request=req)
+        raise httpx.HTTPStatusError("404", request=req, response=resp)
+
+    faults.install(faults.FaultInjector()).plan("fetch.http", plan)
+    with pytest.raises(ReadFileException):
+        fetch_original(
+            "http://o.example.com/x.png", str(tmp_path),
+            policy=FetchPolicy(retry=_no_sleep_policy()),
+        )
+    assert len(calls) == 1  # a 404 is deterministic: one attempt only
+
+
+def test_fetch_5xx_and_429_classified_transient():
+    req = httpx.Request("GET", "http://o/x")
+    for status in (500, 503, 429):
+        exc = httpx.HTTPStatusError(
+            str(status), request=req,
+            response=httpx.Response(status, request=req),
+        )
+        assert is_transient_fetch_error(exc)
+    for status in (400, 403, 404):
+        exc = httpx.HTTPStatusError(
+            str(status), request=req,
+            response=httpx.Response(status, request=req),
+        )
+        assert not is_transient_fetch_error(exc)
+    assert is_transient_fetch_error(httpx.ConnectTimeout("t"))
+    assert is_transient_fetch_error(httpx.ReadTimeout("t"))
+    assert not is_transient_fetch_error(ValueError("x"))
+
+
+def test_fetch_breaker_opens_origin_and_sheds_fast(tmp_path):
+    faults.install(faults.FaultInjector()).plan(
+        "fetch.http",
+        faults.fail_n_then_succeed(
+            999, lambda: httpx.ConnectTimeout("origin down")
+        ),
+    )
+    policy = FetchPolicy(
+        retry=_no_sleep_policy(max_attempts=2),
+        breakers=BreakerRegistry(failure_threshold=2, recovery_s=60.0),
+    )
+    # first request: 2 attempts, both fail -> breaker trips at threshold
+    with pytest.raises(ReadFileException):
+        fetch_original(
+            "http://dead.example.com/a.png", str(tmp_path), policy=policy
+        )
+    # second request: the open breaker sheds in sub-millisecond time
+    t0 = time.perf_counter()
+    with pytest.raises(CircuitOpenException):
+        fetch_original(
+            "http://dead.example.com/b.png", str(tmp_path), policy=policy
+        )
+    assert time.perf_counter() - t0 < 0.005
+    # a DIFFERENT origin is unaffected (per-host isolation)
+    faults.clear()
+    body = _png_bytes(seed=9)
+    faults.install(faults.FaultInjector()).plan(
+        "fetch.http", lambda **_: body
+    )
+    ok = fetch_original(
+        "http://alive.example.com/c.png", str(tmp_path), policy=policy
+    )
+    with open(ok, "rb") as fh:
+        assert fh.read() == body
+
+
+def test_fetch_deadline_exhaustion_fails_fast(tmp_path):
+    # a latency spike longer than the whole budget: the NEXT budget
+    # consumer must fail immediately, not wait out its own stage timeout
+    faults.install(faults.FaultInjector()).plan(
+        "fetch.http", faults.latency_spike(0.08, httpx.ReadTimeout("slow"))
+    )
+    policy = FetchPolicy(retry=_no_sleep_policy(max_attempts=5))
+    t0 = time.perf_counter()
+    with pytest.raises(DeadlineExceededException):
+        fetch_original(
+            "http://slow.example.com/x.png", str(tmp_path),
+            policy=policy, deadline=Deadline(0.05),
+        )
+    # one spike burns the budget; the retry loop's deadline check fires
+    # on the next attempt instead of spiking 4 more times
+    assert time.perf_counter() - t0 < 0.5
+
+
+def test_fetch_streaming_cap_is_enforced(tmp_path, monkeypatch):
+    import flyimg_tpu.service.input_source as input_source
+
+    monkeypatch.setattr(input_source, "MAX_SOURCE_BYTES", 1024)
+    faults.install(faults.FaultInjector()).plan(
+        "fetch.http",
+        lambda **_: (_ for _ in ()).throw(
+            AssertionError("cap must reject before any fetch attempt")
+        ),
+    )
+    # local-path branch honors the cap too (and with streaming the HTTP
+    # branch aborts mid-transfer — pinned by the Content-Length/iter_bytes
+    # logic in _http_fetch_once, unit-covered via the local branch here)
+    big = tmp_path / "big.bin"
+    big.write_bytes(b"x" * 2048)
+    with pytest.raises(ReadFileException, match="exceeds"):
+        input_source.fetch_original(str(big), str(tmp_path / "cache"))
+
+
+def test_fetch_part_rename_race_two_writers(tmp_path):
+    """Two concurrent writers for the SAME url: both must succeed and the
+    cache must hold a consistent copy of the body (the .part suffix is
+    per-writer, so neither steals the other's temp file)."""
+    body = _png_bytes(seed=21)
+    barrier = threading.Barrier(2)
+    results, errors = [], []
+
+    def plan(**_ctx):
+        barrier.wait(timeout=5)  # both writers fetch simultaneously
+        return body
+
+    faults.install(faults.FaultInjector()).plan("fetch.http", plan)
+    url = "http://race.example.com/img.png"
+
+    def writer():
+        try:
+            results.append(
+                fetch_original(
+                    url, str(tmp_path), refresh=True,
+                    policy=FetchPolicy(retry=_no_sleep_policy()),
+                )
+            )
+        except BaseException as exc:  # noqa: BLE001 - recorded for assert
+            errors.append(exc)
+
+    threads = [threading.Thread(target=writer) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert not errors
+    assert len(results) == 2 and results[0] == results[1]
+    with open(results[0], "rb") as fh:
+        assert fh.read() == body
+    leftovers = [
+        p for p in (tmp_path).iterdir() if ".part" in p.name
+    ]
+    assert leftovers == []  # no temp junk survives the race
+
+
+# ---------------------------------------------------------------------------
+# Admission control (batcher queue bound)
+
+
+def test_batcher_sheds_when_queue_full():
+    wedge = threading.Event()
+    faults.install(faults.FaultInjector()).plan(
+        "batcher.execute", faults.wedge_until(wedge)
+    )
+    ctl = BatchController(
+        max_batch=4, deadline_ms=10_000.0, lone_flush=True,
+        max_queue_depth=2, shed_retry_after_s=7.0,
+    )
+    try:
+        img = np.zeros((32, 32, 3), dtype=np.uint8)
+        from flyimg_tpu.spec.options import OptionsBag
+        from flyimg_tpu.spec.plan import build_plan
+
+        plan = build_plan(OptionsBag("w_16"), 32, 32)
+        f1 = ctl.submit(img, plan)  # admitted; executor wedges on it
+        f2 = ctl.submit(img, plan)  # admitted (queue depth 2)
+        with pytest.raises(ServiceUnavailableException) as exc_info:
+            ctl.submit(img, plan)   # over the bound: instant shed
+        assert exc_info.value.retry_after_s == 7
+        shed = ctl.metrics.summary()[
+            'flyimg_shed_total{reason="batch queue"}'
+        ]
+        assert shed == 1
+        wedge.set()  # un-wedge: admitted work completes normally
+        assert f1.result(timeout=120).shape == (16, 16, 3)
+        assert f2.result(timeout=120).shape == (16, 16, 3)
+        # resolved futures freed their slots: admission is open again
+        ctl.submit(img, plan).result(timeout=120)
+    finally:
+        wedge.set()
+        ctl.close()
+        faults.clear()
+
+
+def test_streaming_fetch_aborts_on_dead_budget(monkeypatch):
+    """The body loop itself consumes the deadline: a slow-drip origin
+    (each chunk inside the read timeout, forever) cannot hold the socket
+    past the budget."""
+    import contextlib
+
+    import flyimg_tpu.service.input_source as input_source
+
+    class FakeResp:
+        headers = {}
+
+        def raise_for_status(self):
+            pass
+
+        def iter_bytes(self):
+            while True:  # endless drip
+                yield b"x" * 16
+
+    @contextlib.contextmanager
+    def fake_stream(*_a, **_k):
+        yield FakeResp()
+
+    monkeypatch.setattr(input_source.httpx, "stream", fake_stream)
+    deadline = Deadline(0.01)
+    time.sleep(0.02)
+    with pytest.raises(DeadlineExceededException):
+        input_source._http_fetch_once(
+            "http://drip.example.com/x", {}, None, deadline
+        )
+
+
+def test_batcher_survives_raising_fault_plan():
+    """An injected fault at batcher.execute fails that group's futures —
+    never the singleton executor thread (a dead executor would strand
+    every later submission)."""
+    from flyimg_tpu.spec.options import OptionsBag
+    from flyimg_tpu.spec.plan import build_plan
+
+    faults.install(faults.FaultInjector()).plan(
+        "batcher.execute",
+        lambda **_: (_ for _ in ()).throw(RuntimeError("injected")),
+    )
+    ctl = BatchController(max_batch=2, deadline_ms=1.0)
+    try:
+        img = np.zeros((32, 32, 3), dtype=np.uint8)
+        plan = build_plan(OptionsBag("w_16"), 32, 32)
+        fut = ctl.submit(img, plan)
+        with pytest.raises(RuntimeError, match="injected"):
+            fut.result(timeout=30)
+        faults.clear()  # executor must still be alive to serve this:
+        assert ctl.submit(img, plan).result(timeout=120).shape == (16, 16, 3)
+    finally:
+        ctl.close()
+
+
+def test_admission_slot_freed_on_failure():
+    from flyimg_tpu.runtime.resilience import AdmissionGate
+
+    gate = AdmissionGate(max_pending=1)
+    gate.acquire()
+    with pytest.raises(ServiceUnavailableException):
+        gate.acquire()
+    gate.release()
+    gate.acquire()  # slot is reusable after release
+    assert gate.pending == 1
+
+
+# ---------------------------------------------------------------------------
+# HTTP end-to-end: status mapping + wedged executor + deadline 504
+
+
+def _params(tmp_path, **extra):
+    base = {
+        "tmp_dir": str(tmp_path / "tmp"),
+        "upload_dir": str(tmp_path / "uploads"),
+        "batch_deadline_ms": 1.0,
+    }
+    base.update(extra)
+    return AppParameters(base)
+
+
+def _serve(tmp_path, coro_fn, **params_extra):
+    from flyimg_tpu.service.app import make_app
+
+    async def go():
+        app = make_app(_params(tmp_path, **params_extra))
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            return await coro_fn(client)
+        finally:
+            await client.close()
+
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(go())
+    finally:
+        loop.close()
+
+
+@pytest.fixture()
+def source_png(tmp_path):
+    path = tmp_path / "source.png"
+    path.write_bytes(_png_bytes(80, 64, seed=11))
+    return str(path)
+
+
+def test_http_full_queue_returns_503_with_retry_after(tmp_path, source_png):
+    wedge = threading.Event()
+    injector = faults.FaultInjector()
+    injector.plan("batcher.execute", faults.wedge_until(wedge))
+
+    async def scenario(client):
+        # rf_1 defeats both the output cache and single-flight coalescing
+        # (distinct options -> distinct output names), so each request
+        # reaches the batcher
+        first = asyncio.ensure_future(
+            client.get(f"/upload/w_20,o_png,rf_1/{source_png}")
+        )
+        # wait until the wedged executor actually holds request #1
+        for _ in range(100):
+            await asyncio.sleep(0.02)
+            if injector.fired.get("batcher.execute"):
+                break
+        shed = await client.get(f"/upload/w_21,o_png,rf_1/{source_png}")
+        body = await shed.text()
+        wedge.set()
+        ok = await first
+        return shed.status, dict(shed.headers), body, ok.status
+
+    status, headers, body, first_status = _serve(
+        tmp_path, scenario,
+        fault_injector=injector,
+        batch_max_queue_depth=1,
+        shed_retry_after_s=3.0,
+        wedged_executor_fallback=False,
+    )
+    assert status == 503
+    assert headers["Retry-After"] == "3"
+    assert "ServiceUnavailableException" in body
+    assert first_status == 200  # the admitted request still completed
+
+
+def test_http_exhausted_deadline_returns_504_fast(tmp_path, source_png):
+    injector = faults.FaultInjector()
+    # the fetch stage eats the whole budget; the pipeline must 504
+    # immediately instead of waiting out device/encode stage timeouts
+    injector.plan(
+        "fetch.http", faults.latency_spike(0.3, httpx.ReadTimeout("slow"))
+    )
+
+    async def scenario(client):
+        t0 = time.perf_counter()
+        resp = await client.get(
+            "/upload/w_20,o_png,rf_1/http://slow.example.com/img.png"
+        )
+        return resp.status, await resp.text(), time.perf_counter() - t0
+
+    status, body, elapsed = _serve(
+        tmp_path, scenario,
+        fault_injector=injector,
+        request_deadline_s=0.15,
+        retry_max_attempts=1,
+        device_result_timeout_s=30.0,
+    )
+    assert status == 504
+    assert "DeadlineExceededException" in body
+    assert elapsed < 5.0  # nowhere near the 30s device stage cap
+
+
+def test_http_wedged_executor_falls_back_to_direct_path(
+    tmp_path, source_png
+):
+    wedge = threading.Event()
+    injector = faults.FaultInjector()
+    injector.plan("batcher.execute", faults.wedge_until(wedge))
+
+    async def scenario(client):
+        resp = await client.get(
+            f"/upload/w_24,o_png,rf_1/{source_png}"
+        )
+        body = await resp.read()
+        metrics = await (await client.get("/metrics")).text()
+        wedge.set()
+        return resp.status, body, metrics
+
+    status, body, metrics = _serve(
+        tmp_path, scenario,
+        fault_injector=injector,
+        device_result_timeout_s=0.3,   # give up on the wedge quickly
+        wedged_executor_fallback=True,
+    )
+    assert status == 200 and len(body) > 0
+    assert "flyimg_wedged_fallbacks_total 1" in metrics
+
+
+def test_http_open_breaker_rejects_without_fetch(tmp_path):
+    injector = faults.FaultInjector()
+    injector.plan(
+        "fetch.http",
+        faults.fail_n_then_succeed(
+            999, lambda: httpx.ConnectTimeout("down")
+        ),
+    )
+
+    async def scenario(client):
+        url = "/upload/w_20,o_png,rf_1/http://dead.example.com/a.png"
+        first = await client.get(url)
+        t0 = time.perf_counter()
+        second = await client.get(url)
+        return (
+            first.status, second.status, await second.text(),
+            dict(second.headers), time.perf_counter() - t0,
+        )
+
+    first_status, status, body, headers, elapsed = _serve(
+        tmp_path, scenario,
+        fault_injector=injector,
+        breaker_failure_threshold=2,
+        breaker_recovery_s=60.0,
+        retry_max_attempts=2,
+        retry_base_backoff_s=0.001,
+        retry_max_backoff_s=0.002,
+    )
+    assert first_status == 404      # transport failure -> ReadFileException
+    assert status == 503            # breaker open -> typed shed
+    assert "CircuitOpenException" in body
+    assert "Retry-After" in headers
+    assert elapsed < 1.0
+
+
+# ---------------------------------------------------------------------------
+# Storage retries
+
+
+def test_local_storage_write_retries_transient_errno(tmp_path):
+    import errno
+
+    from flyimg_tpu.storage.local import LocalStorage
+
+    metrics = MetricsRegistry()
+    storage = LocalStorage(_params(tmp_path))
+    storage.retry_policy = _no_sleep_policy(metrics=metrics)
+    faults.install(faults.FaultInjector()).plan(
+        "storage.write",
+        faults.fail_n_then_succeed(
+            1, lambda: OSError(errno.EIO, "disk hiccup")
+        ),
+    )
+    storage.write("x.png", b"abc")
+    assert storage.read("x.png") == b"abc"
+    assert (
+        metrics.summary()['flyimg_retries_total{point="storage.write"}'] == 1
+    )
+
+
+def test_local_storage_does_not_retry_missing_file(tmp_path):
+    from flyimg_tpu.storage.local import LocalStorage
+
+    storage = LocalStorage(_params(tmp_path))
+    storage.retry_policy = _no_sleep_policy()
+    assert storage.fetch("nope.png") is None  # FileNotFound: no retry loop
+
+
+def test_make_storage_arms_retry_policy(tmp_path):
+    from flyimg_tpu.storage import make_storage
+
+    storage = make_storage(_params(tmp_path))
+    assert storage.retry_policy is not None
+    assert storage.retry_policy.max_attempts == 3

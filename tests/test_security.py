@@ -7,16 +7,29 @@ from flyimg_tpu.appconfig import AppParameters
 from flyimg_tpu.exceptions import SecurityException
 from flyimg_tpu.service.security import SecurityHandler, decrypt, encrypt
 
+try:
+    import cryptography  # noqa: F401
+
+    HAS_CRYPTO = True
+except ImportError:  # container without the optional dep
+    HAS_CRYPTO = False
+
+needs_crypto = pytest.mark.skipif(
+    not HAS_CRYPTO, reason="cryptography not installed"
+)
+
 
 def params(**over):
     return AppParameters(over)
 
 
+@needs_crypto
 def test_roundtrip():
     token = encrypt("w_200,h_100/https://a.b/c.jpg", "key", "iv")
     assert decrypt(token, "key", "iv") == "w_200,h_100/https://a.b/c.jpg"
 
 
+@needs_crypto
 def test_wrong_key_fails():
     token = encrypt("w_200/https://a.b/c.jpg", "key", "iv")
     assert decrypt(token, "other", "iv") == ""
@@ -30,6 +43,7 @@ def test_check_security_hash_disabled_passthrough():
     ]
 
 
+@needs_crypto
 def test_check_security_hash_roundtrip():
     handler = SecurityHandler(params(security_key="k", security_iv="v"))
     token = handler.encrypt("w_200,h_100/https://a.b/c.jpg")
@@ -65,6 +79,7 @@ def test_restricted_domains_disabled():
     handler.check_restricted_domains("https://anything.net/x.jpg")
 
 
+@needs_crypto
 def test_php_openssl_compat():
     """Pin the exact PHP openssl_encrypt wire format: AES-256-CBC with
     key = first 32 chars of sha256 hex, iv = first 16 chars of sha256 hex,
@@ -86,6 +101,7 @@ def test_php_openssl_compat():
     assert decrypt(php_token, "sekret", "vector") == "w_1/https://a.b/c.png"
 
 
+@needs_crypto
 def test_wire_format_matches_php_openssl_scheme():
     """Independent oracle: the token must equal base64(openssl-CLI AES-256-CBC)
     with PHP's key/iv derivation — sha256 hexdigest TEXT as key bytes
