@@ -74,6 +74,23 @@ SERVER_DEFAULTS: Dict[str, Any] = {
     # sheds as 503
     "device_result_timeout_s": 120.0,
     "wedged_executor_fallback": True,
+    # --- observability knobs (runtime/tracing.py, runtime/logging.py;
+    # docs/observability.md) ---
+    # per-request tracing: spans for fetch/decode/batch-wait/device/encode/
+    # storage, W3C traceparent in/out, /debug/traces retrieval (debug-gated)
+    "tracing_enabled": True,
+    # bounded in-process ring of KEPT traces (tail-based sampling)
+    "tracing_buffer_size": 256,
+    # keep probability for ordinary traces; errors, deadline hits, and
+    # slow requests are ALWAYS kept (tail-based sampling)
+    "tracing_sample_rate": 1.0,
+    # "slow" threshold for the always-keep rule
+    "tracing_slow_threshold_s": 0.5,
+    # structured logging: format json|text, stdlib level name, and the
+    # per-request access line (carries trace_id/span_id for correlation)
+    "log_format": "json",
+    "log_level": "info",
+    "log_access": True,
 }
 
 

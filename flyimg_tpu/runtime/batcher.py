@@ -45,6 +45,7 @@ from flyimg_tpu.ops.compose import (
     make_program_fn,
     plan_layout,
 )
+from flyimg_tpu.runtime import tracing
 from flyimg_tpu.spec.plan import TransformPlan
 from flyimg_tpu.testing import faults
 
@@ -97,6 +98,11 @@ class _Pending:       # ndarray fields ("truth value is ambiguous" in any
     enqueued_at: float
     final_true: Tuple[int, int]     # final valid (h, w) of the output
     needs_slice: bool = False       # output is bucket-padded; slice final_true
+    # trace fan-in: the submitting request's trace + the span that was
+    # active at submit time, so the SHARED batch span can be attached to
+    # every member request's trace (runtime/tracing.py)
+    trace: Optional[object] = None
+    parent_span_id: Optional[str] = None
 
 
 @dataclass
@@ -130,10 +136,15 @@ class BatchController:
         pipeline_depth: int = 2,
         max_queue_depth: int = 0,
         shed_retry_after_s: float = 1.0,
+        name: str = "device",
     ) -> None:
-        from flyimg_tpu.runtime.metrics import MetricsRegistry
+        from flyimg_tpu.runtime.metrics import (
+            MetricsRegistry,
+            escape_label_value,
+        )
         from flyimg_tpu.runtime.resilience import AdmissionGate
 
+        self.name = name
         self.max_batch = max_batch
         self.deadline_s = deadline_ms / 1000.0
         # flush a lone request immediately when the device is idle (cuts
@@ -160,6 +171,15 @@ class BatchController:
             name="batch queue",
             metrics=self.metrics,
         )
+        # live queue-depth gauge: pending = submitted and unresolved
+        # (queued OR executing), sampled at /metrics render time
+        self.metrics.gauge(
+            "flyimg_batcher_queue_depth"
+            f'{{controller="{escape_label_value(name)}"}}',
+            "Pending (queued or executing) submissions per controller",
+            fn=lambda: self.admission.pending,
+        )
+        self._batch_seq = 0  # batch-id counter (executor thread only)
         self._groups: Dict[Tuple, _Group] = {}
         self._lock = threading.Condition()
         self._stop = False
@@ -247,6 +267,7 @@ class BatchController:
             device_plan, rotate_dynamic,
         )
         future: Future = Future()
+        submit_span = tracing.current_span()
         pending = _Pending(
             image=image,
             plan=plan,
@@ -254,6 +275,10 @@ class BatchController:
             enqueued_at=time.monotonic(),
             final_true=final_true,
             needs_slice=needs_slice,
+            trace=tracing.current_trace(),
+            parent_span_id=(
+                submit_span.span_id if submit_span is not None else None
+            ),
         )
         self._admit_and_enqueue(
             key,
@@ -278,12 +303,17 @@ class BatchController:
         ``runner`` must be a stable module-level callable (it is part of
         the group key) returning one result per payload, in order."""
         future: Future = Future()
+        submit_span = tracing.current_span()
         pending = _Pending(
             image=payload,
             plan=None,
             future=future,
             enqueued_at=time.monotonic(),
             final_true=(0, 0),
+            trace=tracing.current_trace(),
+            parent_span_id=(
+                submit_span.span_id if submit_span is not None else None
+            ),
         )
         full_key = ("aux", runner, key)
         # same admission bound as transform submissions: aux work holds
@@ -474,9 +504,37 @@ class BatchController:
 
     # ------------------------------------------------------------------
 
+    @staticmethod
+    def _attach_batch_span(members: List[_Pending], span_obj) -> None:
+        """Fan the SHARED batch span back into every member request's
+        trace (same span id everywhere), re-parented under the span each
+        member had active at submit time."""
+        for member in members:
+            if member.trace is not None:
+                member.trace.attach_shared(span_obj, member.parent_span_id)
+
+    def _start_batch_span(self, name: str, n: int, batch: int,
+                          members: List[_Pending]):
+        """Mint the shared span for one batch launch — only when at least
+        one member is traced (the untraced path must stay free)."""
+        if not any(m.trace is not None for m in members):
+            return None
+        span_obj = tracing.Span(name)
+        span_obj.set_attribute("batch.id", self._batch_seq)
+        span_obj.set_attribute("batch.controller", self.name)
+        span_obj.set_attribute("batch.occupancy", n)
+        span_obj.set_attribute("batch.size", batch)
+        span_obj.set_attribute("batch.padded_slots", batch - n)
+        oldest = min(m.enqueued_at for m in members)
+        span_obj.set_attribute(
+            "batch.queue_wait_s", round(time.monotonic() - oldest, 6)
+        )
+        return span_obj
+
     def _execute(self, group: _Group) -> None:
         members = group.members
         n = len(members)
+        self._batch_seq += 1  # executor thread only; unique per launch
         # fault hook: a blocking plan here wedges the executor thread —
         # the scenario the handler's wedged-executor fallback defends
         # against (flyimg_tpu/testing/faults.py). A RAISING plan must
@@ -490,6 +548,11 @@ class BatchController:
                     member.future.set_exception(exc)
             return
         if group.runner is not None:
+            span_obj = self._start_batch_span("aux_execute", n, n, members)
+            if span_obj is not None:
+                span_obj.set_attribute(
+                    "batch.runner", getattr(group.runner, "__name__", "aux")
+                )
             try:
                 outputs = group.runner([m.image for m in members])
                 if len(outputs) != n:
@@ -508,9 +571,18 @@ class BatchController:
                     "flyimg_aux_items_total",
                     "Items through batched auxiliary programs",
                 ).inc(n)
+                if span_obj is not None:
+                    span_obj.end()
+                    self._attach_batch_span(members, span_obj)
                 for member, result in zip(members, outputs):
                     member.future.set_result(result)
             except Exception as exc:
+                if span_obj is not None:
+                    span_obj.add_event(
+                        "exception", type=type(exc).__name__, message=str(exc)
+                    )
+                    span_obj.end("error")
+                    self._attach_batch_span(members, span_obj)
                 for member in members:
                     if not member.future.done():
                         member.future.set_exception(exc)
@@ -521,6 +593,7 @@ class BatchController:
         batch = _round_batch(n)
         nd = self._n_devices
         batch = -(-batch // nd) * nd
+        span_obj = None
         try:
             bh, bw = group.in_shape
             # dynamic-rotate groups widen in_true with the host-computed
@@ -557,6 +630,11 @@ class BatchController:
                 span_x[i] = span_x[n - 1]
                 out_true[i] = out_true[n - 1]
 
+            # profiling hook: an lru miss here means a NEW batched program
+            # was built — its first call is the XLA compile (possibly
+            # served from the persistent compilation cache, still the
+            # expensive path); a hit reuses an already-jitted callable
+            misses_before = build_batched_program.cache_info().misses
             fn = build_batched_program(
                 batch,
                 group.in_shape,
@@ -567,24 +645,43 @@ class BatchController:
                 self.mesh,
                 group.rotate_dynamic,
             )
+            compile_hit = (
+                build_batched_program.cache_info().misses == misses_before
+            )
+            self.metrics.record_compile_event(compile_hit)
+            span_obj = self._start_batch_span(
+                "device_execute", n, batch, members
+            )
+            if span_obj is not None:
+                span_obj.set_attribute(
+                    "program.compile_cache", "hit" if compile_hit else "miss"
+                )
+                span_obj.set_attribute("program.in_shape", str(group.in_shape))
             # bound the pipeline: at most pipeline_depth batches between
             # dispatch and completed readback (memory + fairness)
             self._inflight.acquire()
             try:
                 # asynchronous dispatch: returns once the launch is
-                # enqueued; pixels land later, read on a drain thread
-                dev_out = fn(
-                    jnp.asarray(images),
-                    jnp.asarray(in_true),
-                    jnp.asarray(span_y),
-                    jnp.asarray(span_x),
-                    jnp.asarray(out_true),
-                )
+                # enqueued; pixels land later, read on a drain thread.
+                # The TraceAnnotation labels the launch in jax.profiler
+                # device traces (/debug/trace) so profiler timelines and
+                # request traces share the batch id.
+                t_dispatch = time.perf_counter()
+                with jax.profiler.TraceAnnotation(
+                    f"flyimg:batch:{self._batch_seq}"
+                ):
+                    dev_out = fn(
+                        jnp.asarray(images),
+                        jnp.asarray(in_true),
+                        jnp.asarray(span_y),
+                        jnp.asarray(span_x),
+                        jnp.asarray(out_true),
+                    )
                 with self._lock:
                     self._inflight_batches.append(members)
                 threading.Thread(
                     target=self._drain,
-                    args=(members, dev_out, n, batch),
+                    args=(members, dev_out, n, batch, t_dispatch, span_obj),
                     name="flyimg-batcher-drain",
                     daemon=True,
                 ).start()
@@ -595,15 +692,40 @@ class BatchController:
                         self._inflight_batches.remove(members)
                 raise
         except Exception as exc:  # pragma: no cover - defensive
+            if span_obj is not None and span_obj.duration_s is None:
+                # dispatch failed after the span was minted: the errored
+                # span must still reach the member traces (tail sampling
+                # keeps exactly these), mirroring the aux/drain paths
+                span_obj.add_event(
+                    "exception", type=type(exc).__name__, message=str(exc)
+                )
+                span_obj.end("error")
+                self._attach_batch_span(members, span_obj)
             for member in members:
                 if not member.future.done():
                     member.future.set_exception(exc)
 
-    def _drain(self, members, dev_out, n: int, batch: int) -> None:
+    def _drain(self, members, dev_out, n: int, batch: int,
+               t_dispatch: Optional[float] = None, span_obj=None) -> None:
         """Blocking device->host read + future resolution for one
         dispatched batch (runs on a daemon drain thread)."""
         try:
             out = np.asarray(dev_out)
+            device_s = (
+                time.perf_counter() - t_dispatch
+                if t_dispatch is not None else None
+            )
+            if device_s is not None:
+                # dispatch -> completed readback: what the batch actually
+                # held the device (and its members) for
+                self.metrics.record_device_batch_seconds(device_s)
+            if span_obj is not None:
+                span_obj.end()
+                if device_s is not None:
+                    span_obj.set_attribute(
+                        "device.seconds", round(device_s, 6)
+                    )
+                self._attach_batch_span(members, span_obj)
             self.metrics.record_batch(n, batch)
             for i, member in enumerate(members):
                 result = out[i]
@@ -612,6 +734,14 @@ class BatchController:
                     result = result[: int(th), : int(tw)]
                 member.future.set_result(np.ascontiguousarray(result))
         except Exception as exc:
+            if span_obj is not None and span_obj.duration_s is None:
+                # not yet ended -> the failure happened before the attach
+                # above; record and attach the errored span instead
+                span_obj.add_event(
+                    "exception", type=type(exc).__name__, message=str(exc)
+                )
+                span_obj.end("error")
+                self._attach_batch_span(members, span_obj)
             for member in members:
                 if not member.future.done():
                     member.future.set_exception(exc)

@@ -1,0 +1,151 @@
+"""Structured JSON logging for the serving tier.
+
+The reference's story is "exceptions to stdout and nginx access logs"
+(SURVEY.md section 5); neither carries the ids needed to join a log line
+to a trace or a metrics spike. This module provides:
+
+- ``JsonFormatter``: one JSON object per line — timestamp, level, logger,
+  message, plus any extras attached to the record (trace_id/span_id,
+  route, status, duration_ms, ...). Fields are flat so every log
+  aggregator (Loki, CloudWatch, jq) can filter on them directly.
+- ``configure_logging(params)``: process-level setup from the ``log_*``
+  appconfig knobs (format json|text, level). Idempotent — safe to call
+  from both the serve CLI and tests.
+- ``access_log(...)``: the structured access-log emitter the HTTP
+  middleware calls once per request, carrying ``trace_id``/``span_id``
+  so any slow or failed request in the log is one ``/debug/traces/{id}``
+  lookup away from its full span tree.
+
+Emission goes through stdlib ``logging`` (logger ``flyimg.access`` for
+access lines, ``flyimg.*`` for subsystem logs), so deployments that
+already route stdlib logging keep working and tests can capture lines
+with ``caplog``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import Optional
+
+__all__ = ["JsonFormatter", "configure_logging", "access_log", "ACCESS_LOGGER"]
+
+ACCESS_LOGGER = "flyimg.access"
+
+# LogRecord attributes that are plumbing, not payload: everything else on
+# a record (the `extra={...}` dict) is emitted as a top-level JSON field
+_RESERVED = frozenset(
+    (
+        "name", "msg", "args", "levelname", "levelno", "pathname", "filename",
+        "module", "exc_info", "exc_text", "stack_info", "lineno", "funcName",
+        "created", "msecs", "relativeCreated", "thread", "threadName",
+        "processName", "process", "taskName", "message", "asctime",
+    )
+)
+
+
+class JsonFormatter(logging.Formatter):
+    """One JSON object per line; record extras become top-level fields."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": round(record.created, 6),
+            "time": time.strftime(
+                "%Y-%m-%dT%H:%M:%S", time.gmtime(record.created)
+            ) + f".{int(record.msecs):03d}Z",
+            "level": record.levelname.lower(),
+            "logger": record.name,
+            "message": record.getMessage(),
+        }
+        for key, value in record.__dict__.items():
+            if key in _RESERVED or key.startswith("_") or key in out:
+                continue
+            try:
+                json.dumps(value)
+            except (TypeError, ValueError):
+                value = repr(value)
+            out[key] = value
+        if record.exc_info and record.exc_info[0] is not None:
+            out["exception"] = self.formatException(record.exc_info)
+        return json.dumps(out, separators=(",", ":"))
+
+
+def configure_logging(params=None, *, stream=None) -> logging.Logger:
+    """Arm the ``flyimg`` logger hierarchy from the ``log_*`` knobs:
+
+    - ``log_format``: ``json`` (default — one object per line) or ``text``
+    - ``log_level``: threshold name (default ``info``)
+
+    Idempotent: re-configuration replaces the handler installed by a
+    previous call instead of stacking duplicates. Returns the root
+    ``flyimg`` logger."""
+    fmt = "json"
+    level_name = "info"
+    if params is not None:
+        fmt = str(params.by_key("log_format", "json")).lower()
+        level_name = str(params.by_key("log_level", "info")).lower()
+    level = getattr(logging, level_name.upper(), logging.INFO)
+
+    logger = logging.getLogger("flyimg")
+    logger.setLevel(level)
+    # replace only OUR previously installed handler (marked), never a
+    # deployment's own handlers
+    for handler in list(logger.handlers):
+        if getattr(handler, "_flyimg_managed", False):
+            logger.removeHandler(handler)
+    handler = logging.StreamHandler(stream)
+    handler._flyimg_managed = True
+    if fmt == "json":
+        handler.setFormatter(JsonFormatter())
+    else:
+        handler.setFormatter(
+            logging.Formatter(
+                "%(asctime)s %(levelname)s %(name)s %(message)s"
+            )
+        )
+    logger.addHandler(handler)
+    # stop double-printing through the root logger once we own a handler
+    logger.propagate = False
+    return logger
+
+
+def access_log(
+    *,
+    method: str,
+    path: str,
+    route: str,
+    status: int,
+    duration_s: float,
+    bytes_sent: int = 0,
+    remote: Optional[str] = None,
+    trace_id: Optional[str] = None,
+    span_id: Optional[str] = None,
+    user_agent: Optional[str] = None,
+) -> None:
+    """One structured access-log line per request. ``trace_id``/``span_id``
+    correlate the line with its trace in ``/debug/traces/{id}``."""
+    extra = {
+        "method": method,
+        "path": path,
+        "route": route,
+        "status": int(status),
+        "duration_ms": round(duration_s * 1000.0, 3),
+        "bytes": int(bytes_sent),
+    }
+    if remote:
+        extra["remote"] = remote
+    if trace_id:
+        extra["trace_id"] = trace_id
+    if span_id:
+        extra["span_id"] = span_id
+    if user_agent:
+        extra["user_agent"] = user_agent
+    level = logging.INFO
+    if status >= 500:
+        level = logging.ERROR
+    elif status >= 400:
+        level = logging.WARNING
+    logging.getLogger(ACCESS_LOGGER).log(
+        level, "%s %s -> %s", method, path, status, extra=extra
+    )

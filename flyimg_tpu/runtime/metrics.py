@@ -1,4 +1,5 @@
-"""Service metrics: counters + latency histograms + Prometheus rendering.
+"""Service metrics: counters + gauges + latency histograms + Prometheus
+rendering.
 
 The reference has no metrics at all (SURVEY.md section 5 "Metrics /
 logging": exceptions to stdout and nginx access logs are the whole story).
@@ -17,10 +18,9 @@ Design notes:
 
 from __future__ import annotations
 
-import math
 import threading
 import time
-from typing import Dict, Iterable, List, Tuple
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 # log-spaced latency buckets in seconds: 23 buckets, x1.8 apart,
 # 120us .. ~113s — covers device-batch latencies through cold compiles.
@@ -30,6 +30,20 @@ _N_BUCKETS = 23
 BUCKET_BOUNDS: Tuple[float, ...] = tuple(
     _BUCKET_BASE * _BUCKET_FACTOR ** i for i in range(_N_BUCKETS)
 )
+
+
+def escape_label_value(value: str) -> str:
+    """Prometheus label-value escaping (exposition format allows \\\\ \\"
+    \\n only). EVERY label whose value is not a literal in this module
+    goes through here — route/stage/point/reason strings reach the
+    registry from request paths and a crafted value must not corrupt the
+    exposition format."""
+    return (
+        str(value)
+        .replace("\\", r"\\")
+        .replace('"', r"\"")
+        .replace("\n", r"\n")
+    )
 
 
 class Counter:
@@ -47,6 +61,44 @@ class Counter:
 
     @property
     def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Point-in-time value: settable, inc/dec-able, or backed by a
+    callback (``fn``) sampled at render time — the right shape for
+    in-flight request counts, queue depths, and open-breaker counts,
+    which are states, not monotonic totals."""
+
+    def __init__(self, name: str, help_text: str = "",
+                 fn: Optional[Callable[[], float]] = None) -> None:
+        self.name = name
+        self.help = help_text
+        self._fn = fn
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value -= amount
+
+    @property
+    def value(self) -> float:
+        if self._fn is not None:
+            try:
+                return float(self._fn())
+            except Exception:
+                # a dead callback must not take /metrics down with it
+                return float("nan")
         with self._lock:
             return self._value
 
@@ -74,18 +126,28 @@ class Histogram:
             self._n += 1
 
     def quantile(self, q: float) -> float:
-        """Upper-bound estimate of the q-quantile (0 < q <= 1)."""
+        """Estimate of the q-quantile (0 < q <= 1), interpolated linearly
+        within the winning bucket (the histogram_quantile() rule):
+        returning the bucket's upper bound over-reported p50/p99 by up to
+        one bucket factor (1.8x) whenever the mass sat near a bucket's
+        lower edge. Overflow-bucket quantiles stay +inf — there is no
+        upper bound to interpolate toward."""
         with self._lock:
             n = self._n
             counts = list(self._counts)
         if n == 0:
             return 0.0
-        target = math.ceil(q * n)
+        target = q * n
         acc = 0
         for i, c in enumerate(counts):
+            prev = acc
             acc += c
-            if acc >= target:
-                return BUCKET_BOUNDS[i] if i < _N_BUCKETS else float("inf")
+            if acc >= target and c > 0:
+                if i >= _N_BUCKETS:
+                    return float("inf")
+                lo = BUCKET_BOUNDS[i - 1] if i > 0 else 0.0
+                hi = BUCKET_BOUNDS[i]
+                return lo + (hi - lo) * ((target - prev) / c)
         return float("inf")
 
     def snapshot(self) -> Tuple[List[int], float, int]:
@@ -99,6 +161,7 @@ class MetricsRegistry:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
         self._histograms: Dict[str, Histogram] = {}
         self.started_at = time.time()
 
@@ -108,6 +171,20 @@ class MetricsRegistry:
             if metric is None:
                 metric = Counter(name, help_text)
                 self._counters[name] = metric
+            return metric
+
+    def gauge(self, name: str, help_text: str = "",
+              fn: Optional[Callable[[], float]] = None) -> Gauge:
+        """Get-or-create a gauge; ``fn`` (sampled at render time) wins on
+        first creation and is re-armed on later calls that pass one — so
+        wiring code can idempotently re-register a callback."""
+        with self._lock:
+            metric = self._gauges.get(name)
+            if metric is None:
+                metric = Gauge(name, help_text, fn=fn)
+                self._gauges[name] = metric
+            elif fn is not None:
+                metric._fn = fn
             return metric
 
     def histogram(self, name: str, help_text: str = "") -> Histogram:
@@ -121,16 +198,36 @@ class MetricsRegistry:
     # -- recording helpers used by the serving path ------------------------
 
     def record_request(self, route: str, status: int) -> None:
+        # route can derive from a client-controlled path segment: escape
+        # it like record_breaker escapes host, or a crafted segment could
+        # corrupt the exposition format
+        safe = escape_label_value(route)
         self.counter(
-            f'flyimg_requests_total{{route="{route}",status="{status}"}}',
+            f'flyimg_requests_total{{route="{safe}",status="{int(status)}"}}',
             "HTTP requests by route and status",
         ).inc()
 
     def record_stage(self, stage: str, seconds: float) -> None:
         self.histogram(
-            f'flyimg_stage_seconds{{stage="{stage}"}}',
+            f'flyimg_stage_seconds{{stage="{escape_label_value(stage)}"}}',
             "Per-stage pipeline latency",
         ).observe(seconds)
+
+    def record_device_batch_seconds(self, seconds: float) -> None:
+        """Wall time of one device batch from dispatch to completed
+        device->host readback (runtime/batcher.py profiling hook)."""
+        self.histogram(
+            "flyimg_device_seconds",
+            "Per-batch device time, dispatch to completed readback",
+        ).observe(seconds)
+
+    def record_compile_event(self, cache_hit: bool) -> None:
+        """Batched-program compile cache outcome per device batch."""
+        result = "hit" if cache_hit else "miss"
+        self.counter(
+            f'flyimg_compile_events_total{{result="{result}"}}',
+            "Device-program compile cache outcomes per batch",
+        ).inc()
 
     def record_cache(self, hit: bool) -> None:
         self.counter(
@@ -142,17 +239,14 @@ class MetricsRegistry:
 
     def record_retry(self, point: str) -> None:
         self.counter(
-            f'flyimg_retries_total{{point="{point}"}}',
+            f'flyimg_retries_total{{point="{escape_label_value(point)}"}}',
             "Transient-failure retries by pipeline point",
         ).inc()
 
     def record_breaker(self, host: str, state: str) -> None:
         # host derives from a client-controlled URL: escape it so a crafted
-        # value cannot break the exposition format (label values allow
-        # escaped \" \\ \n only)
-        safe = (
-            host.replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
-        )
+        # value cannot break the exposition format
+        safe = escape_label_value(host)
         self.counter(
             f'flyimg_breaker_transitions_total{{host="{safe}",to="{state}"}}',
             "Circuit-breaker state transitions by upstream host",
@@ -160,13 +254,14 @@ class MetricsRegistry:
 
     def record_shed(self, reason: str) -> None:
         self.counter(
-            f'flyimg_shed_total{{reason="{reason}"}}',
+            f'flyimg_shed_total{{reason="{escape_label_value(reason)}"}}',
             "Requests shed by admission control / open circuits",
         ).inc()
 
     def record_deadline_hit(self, stage: str) -> None:
         self.counter(
-            f'flyimg_deadline_exceeded_total{{stage="{stage}"}}',
+            "flyimg_deadline_exceeded_total"
+            f'{{stage="{escape_label_value(stage)}"}}',
             "Requests that exhausted their latency budget, by stage",
         ).inc()
 
@@ -191,6 +286,7 @@ class MetricsRegistry:
         lines: List[str] = []
         with self._lock:
             counters = list(self._counters.values())
+            gauges = list(self._gauges.values())
             histograms = list(self._histograms.values())
 
         for family in _families(counters):
@@ -200,6 +296,14 @@ class MetricsRegistry:
                 lines.append(f"# TYPE {_bare(head.name)} counter")
             for c in family:
                 lines.append(f"{c.name} {_fmt(c.value)}")
+
+        for family in _families(gauges):
+            head = family[0]
+            if head.help:
+                lines.append(f"# HELP {_bare(head.name)} {head.help}")
+                lines.append(f"# TYPE {_bare(head.name)} gauge")
+            for g in family:
+                lines.append(f"{g.name} {_fmt(g.value)}")
 
         for family in _families(histograms):
             head = family[0]
@@ -221,6 +325,8 @@ class MetricsRegistry:
                     )
                 lines.append(f"{_suffixed(h.name, '_sum')} {_fmt(total)}")
                 lines.append(f"{_suffixed(h.name, '_count')} {n}")
+        lines.append("# HELP flyimg_uptime_seconds Process uptime")
+        lines.append("# TYPE flyimg_uptime_seconds gauge")
         lines.append(
             f"flyimg_uptime_seconds {_fmt(time.time() - self.started_at)}"
         )
@@ -274,6 +380,10 @@ def _with_label(name: str, key: str, value: str, suffix: str = "") -> str:
 
 
 def _fmt(value: float) -> str:
+    if value != value:  # NaN (a dead gauge callback): int() would raise
+        return "NaN"
+    if value in (float("inf"), float("-inf")):
+        return "+Inf" if value > 0 else "-Inf"
     if value == int(value) and abs(value) < 1e15:
         return str(int(value))
     return repr(value)

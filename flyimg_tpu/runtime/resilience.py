@@ -43,6 +43,7 @@ from flyimg_tpu.exceptions import (
     DeadlineExceededException,
     ServiceUnavailableException,
 )
+from flyimg_tpu.runtime import tracing
 
 __all__ = [
     "Deadline",
@@ -111,6 +112,11 @@ class Deadline:
         if self.expired:
             if self._metrics is not None:
                 self._metrics.record_deadline_hit(stage or "unknown")
+            tracing.add_event(
+                "deadline.exceeded",
+                stage=stage or "unknown",
+                budget_s=self.budget_s,
+            )
             raise DeadlineExceededException(
                 f"request deadline exceeded"
                 f"{f' at stage {stage!r}' if stage else ''} "
@@ -183,6 +189,13 @@ class RetryPolicy:
                     raise
                 if self.metrics is not None:
                     self.metrics.record_retry(point or "unknown")
+                tracing.add_event(
+                    "retry",
+                    point=point or "unknown",
+                    attempt=attempt,
+                    backoff_s=round(delay, 4),
+                    error=type(exc).__name__,
+                )
                 if delay > 0:
                     self.sleep(delay)
 
@@ -251,6 +264,11 @@ class CircuitBreaker:
         self._state = to
         if self._metrics is not None:
             self._metrics.record_breaker(self.name or "upstream", to)
+        # a transition triggered by THIS request lands in its trace (the
+        # trace lock never takes the breaker lock, so ordering is safe)
+        tracing.add_event(
+            "breaker.transition", host=self.name or "upstream", to=to
+        )
 
     def allow(self) -> None:
         """Admit one attempt or raise ``CircuitOpenException`` (fast)."""
@@ -270,6 +288,10 @@ class CircuitBreaker:
             self._probe_inflight = True
 
     def _rejection(self, retry_after: float) -> CircuitOpenException:
+        tracing.add_event(
+            "breaker.shed", host=self.name or "upstream",
+            retry_after_s=round(max(retry_after, 0.0), 3),
+        )
         exc = CircuitOpenException(
             f"upstream {self.name or 'origin'!s} circuit is open "
             f"(recently failing); retry in ~{max(retry_after, 0.0):.1f}s"
@@ -362,6 +384,15 @@ class BreakerRegistry:
             self._breakers[host] = breaker
             return breaker
 
+    def open_count(self) -> int:
+        """Breakers currently NOT closed (open or half-open) — the
+        `flyimg_breaker_open` gauge callback (service wiring)."""
+        with self._lock:
+            breakers = list(self._breakers.values())
+        return sum(
+            1 for brk in breakers if brk.state != CircuitBreaker.CLOSED
+        )
+
     @classmethod
     def from_params(cls, params, *, metrics=None) -> "BreakerRegistry":
         return cls(
@@ -412,6 +443,10 @@ class AdmissionGate:
             if self.max_pending > 0 and self._pending >= self.max_pending:
                 if self.metrics is not None:
                     self.metrics.record_shed(self.name)
+                tracing.add_event(
+                    "shed", reason=self.name, pending=self._pending,
+                    max_pending=self.max_pending,
+                )
                 exc = ServiceUnavailableException(
                     f"{self.name} is full ({self._pending}/"
                     f"{self.max_pending} pending); shedding load"

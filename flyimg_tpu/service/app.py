@@ -8,6 +8,10 @@ app. Routes preserved exactly:
     GET /upload/{options}/{imageSrc:.+}     -> transformed image bytes
     GET /path/{options}/{imageSrc:.+}       -> public URL of the stored file
 
+plus the observability surface (docs/observability.md): /metrics,
+/healthz, and — debug-gated — /debug/trace (jax.profiler capture),
+/debug/traces (tail-sampled trace ring), /debug/traces/{id} (span tree).
+
 plus the ``encrypt`` CLI subcommand (reference app.php:93-96):
 
     python -m flyimg_tpu.service.app encrypt '<options>/<url>'
@@ -59,6 +63,11 @@ DEFAULT_ROUTES = {
 PARAMS_KEY: web.AppKey[AppParameters] = web.AppKey("params", AppParameters)
 HANDLER_KEY: web.AppKey[ImageHandler] = web.AppKey("handler", ImageHandler)
 METRICS_KEY: web.AppKey = web.AppKey("metrics", object)
+TRACER_KEY: web.AppKey = web.AppKey("tracer", object)
+
+# routes that run the image pipeline get a trace; infrastructure routes
+# (/metrics scrapes, health probes) would only fill the ring with noise
+_TRACED_ROUTES = frozenset(("upload", "path"))
 
 _ERROR_STATUS = {
     SecurityException: 403,
@@ -120,10 +129,13 @@ function go() {
 
 def make_app(params: Optional[AppParameters] = None) -> web.Application:
     params = params or AppParameters()
-    from flyimg_tpu.runtime import BatchController
+    from flyimg_tpu.runtime import BatchController, tracing
+    from flyimg_tpu.runtime.logging import access_log
     from flyimg_tpu.runtime.metrics import MetricsRegistry
 
     metrics = MetricsRegistry()
+    tracer = tracing.Tracer.from_params(params, metrics=metrics)
+    log_access = bool(params.by_key("log_access", True))
     storage = make_storage(params, metrics=metrics)
     import jax
 
@@ -198,6 +210,7 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
         pipeline_depth=int(params.by_key("batch_pipeline_depth", 2)),
         max_queue_depth=int(params.by_key("batch_max_queue_depth", 0)),
         shed_retry_after_s=shed_retry_after,
+        name="device",
     )
     # host codec work gets its OWN controller/thread: JPEG-miss decode
     # batches (native DecodePool) must not serialize with device launches
@@ -207,6 +220,7 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
         metrics=metrics,
         max_queue_depth=int(params.by_key("decode_max_queue_depth", 0)),
         shed_retry_after_s=shed_retry_after,
+        name="codec",
     )
     # fault-injection hook (flyimg_tpu/testing/faults.py): tests assemble
     # a full app with scripted faults at named pipeline points; absent in
@@ -228,35 +242,102 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
         storage, params, batcher=batcher, codec_batcher=codec_batcher,
         face_backend=face_backend, metrics=metrics, sp_mesh=sp_mesh,
     )
+    # state gauges (runtime/metrics.py Gauge): sampled at /metrics render
+    inflight = metrics.gauge(
+        "flyimg_inflight_requests", "HTTP requests currently in flight"
+    )
+    metrics.gauge(
+        "flyimg_breaker_open",
+        "Upstream circuit breakers currently open or half-open",
+        fn=handler.fetch_policy.breakers.open_count,
+    )
+    metrics.gauge(
+        "flyimg_traces_buffered",
+        "Traces held in the tail-sampling ring buffer",
+        fn=lambda: len(tracer),
+    )
 
     @web.middleware
-    async def request_metrics(request: web.Request, handler):
-        """Count every request by route/status — including unexpected 500s,
-        which are exactly what a metrics endpoint exists to surface.
+    async def observability(request: web.Request, handler):
+        """The one per-request observability choke point: request/status
+        metrics (including unexpected 500s), the in-flight gauge, trace
+        lifecycle for pipeline routes (mint-or-adopt at ingress, tail
+        sample at completion, `traceparent` echoed on the response), and
+        the structured JSON access log carrying trace/span ids.
         (The `handler` param name is required by aiohttp and shadows the
         ImageHandler binding only inside this function.)"""
-        route = (
+        # logical route name when registered (upload/path keep their names
+        # under `routes` pattern overrides — a renamed pattern must not
+        # silently disable tracing); canonical path segment otherwise
+        route = request.match_info.route.name or (
             request.match_info.route.resource.canonical.strip("/").split("/")[0]
             if request.match_info.route.resource is not None
             else "unmatched"
         ) or "index"
+        trace = None
+        if route in _TRACED_ROUTES:
+            trace = tracer.start(request.headers.get("traceparent"))
+            if trace is not None:
+                trace.root.set_attribute("route", route)
+                trace.root.set_attribute("http.method", request.method)
+                trace.root.set_attribute("http.path", request.path)
+                if request.remote:
+                    trace.root.set_attribute("net.peer", request.remote)
+                request["flyimg.trace"] = trace
+        inflight.inc()
+        t0 = time.perf_counter()
+        status = 500
+        response = None
         try:
             response = await handler(request)
+            status = response.status
+            return response
         except web.HTTPException as exc:
-            metrics.record_request(route, exc.status)
+            status = exc.status
             raise
-        except Exception:
-            metrics.record_request(route, 500)
-            raise
-        metrics.record_request(route, response.status)
-        return response
+        finally:
+            inflight.dec()
+            duration = time.perf_counter() - t0
+            metrics.record_request(route, status)
+            if trace is not None:
+                trace.root.set_attribute("http.status", status)
+                tracer.finish(
+                    trace, "error" if status >= 500 else "ok"
+                )
+                if response is not None:
+                    # echo OUR position in the trace so the caller (and
+                    # any test) can join response -> trace -> span tree
+                    response.headers["traceparent"] = (
+                        tracing.format_traceparent(
+                            trace.trace_id, trace.root.span_id
+                        )
+                    )
+            if log_access:
+                access_log(
+                    method=request.method,
+                    path=request.path_qs,
+                    route=route,
+                    status=status,
+                    duration_s=duration,
+                    bytes_sent=(
+                        response.content_length or 0
+                        if response is not None else 0
+                    ),
+                    remote=request.remote,
+                    trace_id=trace.trace_id if trace is not None else None,
+                    span_id=(
+                        trace.root.span_id if trace is not None else None
+                    ),
+                    user_agent=request.headers.get("User-Agent"),
+                )
 
     app = web.Application(
-        client_max_size=64 * 1024 * 1024, middlewares=[request_metrics]
+        client_max_size=64 * 1024 * 1024, middlewares=[observability]
     )
     app[PARAMS_KEY] = params
     app[HANDLER_KEY] = handler
     app[METRICS_KEY] = metrics
+    app[TRACER_KEY] = tracer
 
     async def _close_batcher(_app):
         batcher.close()
@@ -319,14 +400,21 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
         # time in the executor counts against it, so an overloaded
         # worker pool surfaces as fast 504s rather than invisible queueing
         deadline = Deadline.from_params(params, metrics=metrics)
+        trace = request.get("flyimg.trace")
+        accepts_webp = _accepts_webp(request)
         loop = asyncio.get_running_loop()
-        return await loop.run_in_executor(
-            None,
-            lambda: handler.process_image(
-                options, image_src, accepts_webp=_accepts_webp(request),
-                deadline=deadline,
-            ),
-        )
+
+        def run():
+            # the trace binds ambient INSIDE the worker thread: executor
+            # threads don't inherit asyncio context, and every pipeline
+            # stage below reads it through tracing.current_trace()
+            with tracing.activate(trace):
+                return handler.process_image(
+                    options, image_src, accepts_webp=accepts_webp,
+                    deadline=deadline,
+                )
+
+        return await loop.run_in_executor(None, run)
 
     async def index(_request: web.Request) -> web.Response:
         return web.Response(text=HOMEPAGE, content_type="text/html")
@@ -423,10 +511,55 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
             content_type="application/json",
         )
 
+    def _debug_gate() -> Optional[web.Response]:
+        if not params.by_key("debug"):
+            return web.Response(
+                status=403, text="debug disabled (set debug: true in params)"
+            )
+        return None
+
+    async def debug_traces_list(request: web.Request) -> web.Response:
+        """Kept traces, newest first (summaries). Operator tool — gated
+        on the `debug` server parameter like /debug/trace."""
+        import json as _json
+
+        denied = _debug_gate()
+        if denied is not None:
+            return denied
+        try:
+            limit = min(int(request.query.get("limit", 100)), 1000)
+        except ValueError:
+            return web.Response(status=400, text="limit must be an integer")
+        return web.Response(
+            text=_json.dumps({"traces": tracer.list(limit=limit)}),
+            content_type="application/json",
+        )
+
+    async def debug_traces_get(request: web.Request) -> web.Response:
+        """Full span tree of one kept trace as JSON."""
+        import json as _json
+
+        denied = _debug_gate()
+        if denied is not None:
+            return denied
+        trace = tracer.get(request.match_info["trace_id"])
+        if trace is None:
+            return web.Response(
+                status=404,
+                text="no such trace (dropped by the tail sampler, evicted "
+                     "from the ring, or never seen)",
+            )
+        return web.Response(
+            text=_json.dumps(trace.as_dict()),
+            content_type="application/json",
+        )
+
     app.router.add_get("/", index)
     app.router.add_get("/metrics", metrics_route)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/debug/trace", debug_trace)
+    app.router.add_get("/debug/traces", debug_traces_list)
+    app.router.add_get("/debug/traces/{trace_id}", debug_traces_get)
     # Route table is config-overridable like the reference's
     # config/routes.yml (RoutesResolver.php); imageSrc uses a catch-all
     # pattern so full URLs (with slashes) work as path parameters — the
@@ -448,7 +581,10 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
                 f"route pattern for {name!r} must contain {{options}} and "
                 f"{{imageSrc:.+}} placeholders, got {pattern!r}"
             )
-        app.router.add_get(pattern, handlers[name])
+        # named: the observability middleware keys tracing and the route
+        # metric label on the LOGICAL name, so pattern overrides keep
+        # stable labels and stay traced
+        app.router.add_get(pattern, handlers[name], name=name)
     return app
 
 
@@ -513,7 +649,11 @@ def main(argv=None) -> int:
         return 0
     if args.cmd == "serve":
         from flyimg_tpu.parallel.dist import initialize_multihost
+        from flyimg_tpu.runtime.logging import configure_logging
 
+        # structured JSON logs (log_format/log_level knobs) before any
+        # subsystem logs a line; access lines join them per request
+        configure_logging(params)
         # multi-host pods: wire the DCN coordination plane before any mesh
         # is built so jax.devices() is the global view (no-op single host)
         initialize_multihost()

@@ -27,6 +27,7 @@ from flyimg_tpu.appconfig import AppParameters
 from flyimg_tpu.codecs import decode, encode, media_info
 from flyimg_tpu.exceptions import ServiceUnavailableException
 from flyimg_tpu.ops.compose import run_plan
+from flyimg_tpu.runtime import tracing
 from flyimg_tpu.runtime.resilience import Deadline
 from flyimg_tpu.service.input_source import FetchPolicy, load_source
 from flyimg_tpu.service.output_image import OutputSpec, resolve_output
@@ -198,14 +199,20 @@ class ImageHandler:
             separator=self.params.by_key("options_separator", ","),
         )
 
-        source = load_source(
-            image_src,
-            options,
-            self.params.by_key("tmp_dir", "var/tmp"),
-            header_extra_options=self.params.by_key("header_extra_options", ""),
-            policy=self.fetch_policy,
-            deadline=deadline,
-        )
+        with tracing.span("fetch") as fetch_span:
+            source = load_source(
+                image_src,
+                options,
+                self.params.by_key("tmp_dir", "var/tmp"),
+                header_extra_options=self.params.by_key(
+                    "header_extra_options", ""
+                ),
+                policy=self.fetch_policy,
+                deadline=deadline,
+            )
+            if fetch_span is not None:
+                fetch_span.set_attribute("source.bytes", len(source.data))
+                fetch_span.set_attribute("source.mime", source.info.mime)
         timings["fetch"] = time.perf_counter() - t0
 
         spec = resolve_output(
@@ -218,9 +225,11 @@ class ImageHandler:
 
         # ONE round trip answers cached? + bytes + stored-when? (separate
         # has/read/head calls would tax S3 serving's hot path 2-3x)
-        cached = None if refresh else self.storage.fetch(spec.name)
+        with tracing.span("storage", op="fetch"):
+            cached = None if refresh else self.storage.fetch(spec.name)
         if cached is not None:
             content, stat = cached
+            tracing.add_event("cache.hit", key=spec.name)
             if self.metrics is not None:
                 self.metrics.record_cache(hit=True)
                 self.metrics.record_stage("cache_hit", time.perf_counter() - t0)
@@ -244,11 +253,12 @@ class ImageHandler:
                 # but healthy leader (multi-frame GIF, several post-pass
                 # waits) must NOT shed its followers — only a wedged one.
                 # The follower's own deadline caps the wait regardless.
-                content, modified_at = flight.result(
-                    timeout=deadline.timeout(
-                        5 * self.device_result_timeout_s
+                with tracing.span("coalesced_wait", key=spec.name):
+                    content, modified_at = flight.result(
+                        timeout=deadline.timeout(
+                            5 * self.device_result_timeout_s
+                        )
                     )
-                )
             except FutureTimeout:
                 deadline.check("coalesced")  # budget gone -> 504, not 503
                 raise ServiceUnavailableException(
@@ -277,7 +287,8 @@ class ImageHandler:
             )
             # write() returns the stored mtime so neither the leader nor
             # its followers re-query metadata for bytes written just now
-            modified_at = self.storage.write(spec.name, content)
+            with tracing.span("storage", op="write", bytes=len(content)):
+                modified_at = self.storage.write(spec.name, content)
         except BaseException as exc:
             self._singleflight.done(spec.name, exc=exc)
             raise
@@ -632,12 +643,17 @@ class ImageHandler:
         hint = decode_target_hint(options)
 
         gif_frame = options.int_option("gif-frame", 0) or 0
-        data_info = media_info(data)  # one probe, shared by both paths
-        decoded = self._decode_batched(data, hint, data_info, deadline)
-        if decoded is None:
-            decoded = decode(
-                data, target_hint=hint, frame=gif_frame, info=data_info
-            )
+        with tracing.span("decode") as decode_span:
+            data_info = media_info(data)  # one probe, shared by both paths
+            decoded = self._decode_batched(data, hint, data_info, deadline)
+            batched_decode = decoded is not None
+            if decoded is None:
+                decoded = decode(
+                    data, target_hint=hint, frame=gif_frame, info=data_info
+                )
+            if decode_span is not None:
+                decode_span.set_attribute("decode.mime", data_info.mime)
+                decode_span.set_attribute("decode.batched", batched_decode)
         timings["decode"] = time.perf_counter() - t
 
         w, h = decoded.size
@@ -706,39 +722,48 @@ class ImageHandler:
             if anim is not None and anim.alphas is not None
             else None
         )
-        staged = []
-        for idx, frame in enumerate(frames):
-            fh, fw = frame.shape[:2]
-            frame_plan = plan if (fw, fh) == plan.src_size else build_plan(
-                options, fw, fh
-            )
-            if alpha_start is not None and idx >= alpha_start:
-                from dataclasses import replace as _replace
+        with tracing.span("batch_wait", frames=len(frames)):
+            # submissions happen INSIDE this span so the batcher records
+            # it as the parent of the shared device_execute span it fans
+            # back into this trace (runtime/batcher.py)
+            staged = []
+            for idx, frame in enumerate(frames):
+                fh, fw = frame.shape[:2]
+                frame_plan = plan if (fw, fh) == plan.src_size else build_plan(
+                    options, fw, fh
+                )
+                if alpha_start is not None and idx >= alpha_start:
+                    from dataclasses import replace as _replace
 
-                frame_plan = _replace(
-                    frame_plan,
-                    colorspace=None, monochrome=False,
-                    unsharp=None, sharpen=None, blur=None,
-                    background=(255, 255, 255),
-                )
-            tiled = self._tiled_or_none(frame, frame_plan)
-            if tiled is not None:
-                staged.append((tiled, frame, frame_plan))
-            elif self.batcher is not None:
-                # concurrent requests sharing a program batch into one
-                # device launch; the deadline-aware wait below parks this
-                # worker thread while the group fills
-                # (flyimg_tpu/runtime/batcher.py)
-                staged.append(
-                    (self.batcher.submit(frame, frame_plan), frame, frame_plan)
-                )
-            else:
-                staged.append((run_plan(frame, frame_plan), frame, frame_plan))
-        out_frames = [
-            self._await_transform(s, frame, frame_plan, deadline)
-            if isinstance(s, Future) else s
-            for s, frame, frame_plan in staged
-        ]
+                    frame_plan = _replace(
+                        frame_plan,
+                        colorspace=None, monochrome=False,
+                        unsharp=None, sharpen=None, blur=None,
+                        background=(255, 255, 255),
+                    )
+                tiled = self._tiled_or_none(frame, frame_plan)
+                if tiled is not None:
+                    staged.append((tiled, frame, frame_plan))
+                elif self.batcher is not None:
+                    # concurrent requests sharing a program batch into one
+                    # device launch; the deadline-aware wait below parks
+                    # this worker thread while the group fills
+                    # (flyimg_tpu/runtime/batcher.py)
+                    staged.append(
+                        (
+                            self.batcher.submit(frame, frame_plan),
+                            frame, frame_plan,
+                        )
+                    )
+                else:
+                    staged.append(
+                        (run_plan(frame, frame_plan), frame, frame_plan)
+                    )
+            out_frames = [
+                self._await_transform(s, frame, frame_plan, deadline)
+                if isinstance(s, Future) else s
+                for s, frame, frame_plan in staged
+            ]
         timings["device"] = time.perf_counter() - t
 
         # post-passes on the transformed output, in reference order:
@@ -748,107 +773,122 @@ class ImageHandler:
             out = out_frames[0]
             if plan.smart_crop:
                 t = time.perf_counter()
-                sc = self._smartcrop()
-                if self.batcher is not None and hasattr(sc, "prepare_work"):
-                    # concurrent smc_1 requests score in ONE batched device
-                    # launch per work-shape bucket — the same program shape
-                    # bench.py measures; the per-image path would recompile
-                    # analyse_features for every distinct post-resize size
-                    item = sc.prepare_work(out)
-                    try:
-                        crop = self.batcher.submit_aux(
-                            ("smc", item.bucket, item.step),
-                            item,
-                            sc.find_best_crops_batched,
-                        ).result(timeout=self._device_wait_s(deadline))
-                    except FutureTimeout:
-                        if deadline is not None:
-                            deadline.check("smartcrop")
-                        # wedged executor: score single-image in this thread
-                        self._record_wedge()
-                        out = sc.smart_crop_image(out)
+                with tracing.span("smartcrop"):
+                    sc = self._smartcrop()
+                    if self.batcher is not None and hasattr(
+                        sc, "prepare_work"
+                    ):
+                        # concurrent smc_1 requests score in ONE batched
+                        # device launch per work-shape bucket — the same
+                        # program shape bench.py measures; the per-image
+                        # path would recompile analyse_features for every
+                        # distinct post-resize size
+                        item = sc.prepare_work(out)
+                        try:
+                            crop = self.batcher.submit_aux(
+                                ("smc", item.bucket, item.step),
+                                item,
+                                sc.find_best_crops_batched,
+                            ).result(timeout=self._device_wait_s(deadline))
+                        except FutureTimeout:
+                            if deadline is not None:
+                                deadline.check("smartcrop")
+                            # wedged executor: score single-image in this
+                            # thread
+                            self._record_wedge()
+                            out = sc.smart_crop_image(out)
+                        else:
+                            out = sc.apply_crop(out, crop)
                     else:
-                        out = sc.apply_crop(out, crop)
-                else:
-                    out = sc.smart_crop_image(out)
+                        out = sc.smart_crop_image(out)
                 timings["smartcrop"] = time.perf_counter() - t
             if plan.face_blur or plan.face_crop:
                 t = time.perf_counter()
-                ff = self._faces()
-                if self.batcher is not None and hasattr(ff, "prepare_face_work"):
-                    # batched detection: one mask program per shape bucket
-                    item = ff.prepare_face_work(out)
-                    try:
-                        faces = self.batcher.submit_aux(
-                            ("face", item.bucket), item,
-                            ff.detect_faces_batched,
-                        ).result(timeout=self._device_wait_s(deadline))
-                    except FutureTimeout:
-                        if deadline is not None:
-                            deadline.check("faces")
-                        self._record_wedge()
+                with tracing.span("faces"):
+                    ff = self._faces()
+                    if self.batcher is not None and hasattr(
+                        ff, "prepare_face_work"
+                    ):
+                        # batched detection: one mask program per shape
+                        # bucket
+                        item = ff.prepare_face_work(out)
+                        try:
+                            faces = self.batcher.submit_aux(
+                                ("face", item.bucket), item,
+                                ff.detect_faces_batched,
+                            ).result(timeout=self._device_wait_s(deadline))
+                        except FutureTimeout:
+                            if deadline is not None:
+                                deadline.check("faces")
+                            self._record_wedge()
+                            faces = ff.detect_faces(out)
+                    else:
                         faces = ff.detect_faces(out)
-                else:
-                    faces = ff.detect_faces(out)
-                if plan.face_blur:
-                    out = ff.blur_faces(out, faces)
-                if plan.face_crop:
-                    out = ff.crop_face(out, faces, plan.face_crop_position)
+                    if plan.face_blur:
+                        out = ff.blur_faces(out, faces)
+                    if plan.face_crop:
+                        out = ff.crop_face(out, faces, plan.face_crop_position)
                 timings["faces"] = time.perf_counter() - t
             out_frames = [out]
 
         t = time.perf_counter()
         if deadline is not None:
             deadline.check("encode")
-        # attach-time decision mirrors keeps_alpha (the flatten decision):
-        # attaching alpha to rgb that was already flattened over bg would
-        # double-composite semi-transparent pixels
-        alpha = None
-        if keeps_alpha and len(out_frames) == 1 and \
-                out_frames[0].shape[:2] == decoded.alpha.shape:
-            alpha = decoded.alpha
+        with tracing.span("encode", format=spec.extension) as encode_span:
+            # attach-time decision mirrors keeps_alpha (the flatten
+            # decision): attaching alpha to rgb that was already flattened
+            # over bg would double-composite semi-transparent pixels
+            alpha = None
+            if keeps_alpha and len(out_frames) == 1 and \
+                    out_frames[0].shape[:2] == decoded.alpha.shape:
+                alpha = decoded.alpha
 
-        if anim is not None and len(out_frames) > 1:
-            n = len(anim.frames)
-            out_alphas = None
-            if anim.alphas is not None:
-                # the second half of the staged frames are the transformed
-                # alpha planes; GIF transparency is binary, so threshold
-                # at 128 (IM's behavior quantizing resampled RGBA to GIF)
-                out_alphas = [
-                    np.where(af[..., 0] >= 128, 255, 0).astype(np.uint8)
-                    for af in out_frames[n:]
-                ]
-                out_frames = out_frames[:n]
-            content = _encode_gif_animation(
-                out_frames, out_alphas, anim.durations, anim.loop
-            )
-        else:
-            content = self._encode_one(
-                out_frames[0], spec, options, alpha=alpha, deadline=deadline
-            )
-        # st_0: the reference preserves ALL source metadata when -strip is
-        # off (ImageProcessor.php:97-99) — EXIF, ICC profile, XMP. A
-        # raw-pixel decode loses them, so collect from the source container
-        # (JPEG APPn / PNG iCCP+eXIf / WebP ICCP+EXIF+XMP) and graft into
-        # the output (JPEG APPn train / PNG chunks / WebP VP8X container).
-        # EXIF orientation is reset to 1 — the rotation is baked into the
-        # pixels. GIF outputs drop metadata (the format carries none).
-        if (
-            not options.truthy("strip")
-            and spec.extension in ("jpg", "png", "webp")
-            and len(out_frames) == 1
-        ):
-            from flyimg_tpu.codecs import metadata as meta_mod
+            if anim is not None and len(out_frames) > 1:
+                n = len(anim.frames)
+                out_alphas = None
+                if anim.alphas is not None:
+                    # the second half of the staged frames are the
+                    # transformed alpha planes; GIF transparency is binary,
+                    # so threshold at 128 (IM's behavior quantizing
+                    # resampled RGBA to GIF)
+                    out_alphas = [
+                        np.where(af[..., 0] >= 128, 255, 0).astype(np.uint8)
+                        for af in out_frames[n:]
+                    ]
+                    out_frames = out_frames[:n]
+                content = _encode_gif_animation(
+                    out_frames, out_alphas, anim.durations, anim.loop
+                )
+            else:
+                content = self._encode_one(
+                    out_frames[0], spec, options, alpha=alpha,
+                    deadline=deadline,
+                )
+            # st_0: the reference preserves ALL source metadata when -strip
+            # is off (ImageProcessor.php:97-99) — EXIF, ICC profile, XMP. A
+            # raw-pixel decode loses them, so collect from the source
+            # container (JPEG APPn / PNG iCCP+eXIf / WebP ICCP+EXIF+XMP)
+            # and graft into the output (JPEG APPn train / PNG chunks /
+            # WebP VP8X container). EXIF orientation is reset to 1 — the
+            # rotation is baked into the pixels. GIF outputs drop metadata
+            # (the format carries none).
+            if (
+                not options.truthy("strip")
+                and spec.extension in ("jpg", "png", "webp")
+                and len(out_frames) == 1
+            ):
+                from flyimg_tpu.codecs import metadata as meta_mod
 
-            meta = meta_mod.collect(data, decoded.mime)
-            if meta and parse_colorspace(options) == "cmyk":
-                # the source's RGB ICC profile must not be grafted onto
-                # CMYK samples — color-managed decoders would apply an
-                # RGB profile to 4-component data (EXIF/XMP still carry)
-                meta.icc = None
-            if meta:
-                content = meta_mod.inject(content, spec.extension, meta)
+                meta = meta_mod.collect(data, decoded.mime)
+                if meta and parse_colorspace(options) == "cmyk":
+                    # the source's RGB ICC profile must not be grafted onto
+                    # CMYK samples — color-managed decoders would apply an
+                    # RGB profile to 4-component data (EXIF/XMP still carry)
+                    meta.icc = None
+                if meta:
+                    content = meta_mod.inject(content, spec.extension, meta)
+            if encode_span is not None:
+                encode_span.set_attribute("encode.bytes", len(content))
         timings["encode"] = time.perf_counter() - t
 
         # rf_1 debug header payload (reference `identify` line via the

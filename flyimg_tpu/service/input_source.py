@@ -33,6 +33,7 @@ from flyimg_tpu.codecs import MediaInfo, media_info
 from flyimg_tpu.codecs import pdf as pdf_codec
 from flyimg_tpu.codecs import video as video_codec
 from flyimg_tpu.exceptions import ReadFileException
+from flyimg_tpu.runtime import tracing
 from flyimg_tpu.runtime.resilience import (
     BreakerRegistry,
     Deadline,
@@ -197,6 +198,10 @@ def fetch_original(
         tmp_dir, OptionsBag.hash_original_image_url(image_url)
     )
     if os.path.exists(cache_path) and not refresh:
+        # level-1 (original bytes) cache hit: no network at all — the
+        # trace should say so, or a "fetch" span covering only a disk
+        # read looks like an impossibly fast origin
+        tracing.add_event("fetch.original_cache_hit", path=cache_path)
         return cache_path
     if deadline is not None:
         deadline.check("fetch")
@@ -222,6 +227,7 @@ def fetch_original(
             # breaker.allow(): an admitted half-open probe slot must
             # always reach the record_* below or it would leak and wedge
             # the breaker half-open forever
+            tracing.add_event("fetch.attempt", host=host_of(image_url))
             flat = None
             if deadline is not None:
                 deadline.check("fetch")

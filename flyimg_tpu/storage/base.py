@@ -34,14 +34,27 @@ class Storage(abc.ABC):
         """Run one storage operation under the retry policy (when set) and
         the ``storage.<op>`` fault-injection point. Injected plans may
         raise (simulated backend failure, subject to the same retry
-        classification) or return a value (simulated success)."""
+        classification) or return a value (simulated success). Backend
+        errors land as events on the active request span (retries add
+        their own events via RetryPolicy)."""
+        from flyimg_tpu.runtime import tracing
         from flyimg_tpu.testing import faults
 
         def attempt():
             injected = faults.fire(f"storage.{op}")
             if injected is not faults.PASS:
                 return injected
-            return fn()
+            try:
+                return fn()
+            except Exception as exc:
+                # only transient-classified errors are real backend
+                # hiccups; deterministic ones (FileNotFound = cache miss)
+                # are normal control flow and would spam every trace
+                if self._is_transient(exc):
+                    tracing.add_event(
+                        "storage.error", op=op, error=type(exc).__name__
+                    )
+                raise
 
         if self.retry_policy is None:
             return attempt()

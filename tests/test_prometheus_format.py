@@ -1,0 +1,253 @@
+"""Prometheus text exposition-format conformance.
+
+A strict line grammar over live `/metrics` output: metric/label name
+charsets, label-value escaping, HELP-before-TYPE ordering, one contiguous
+block of samples per family, histogram `le` buckets cumulative and ending
+in `+Inf` with `_count` equal to the `+Inf` bucket. A scraper (or a
+crafted label value) should never be able to find a malformed line here —
+that is the satellite this test pins (ISSUE 2).
+"""
+
+import math
+import re
+
+import pytest
+
+from flyimg_tpu.runtime.metrics import MetricsRegistry
+
+_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_LABEL_NAME = r"[a-zA-Z_][a-zA-Z0-9_]*"
+# label values: any chars, with " \ and newline appearing ONLY escaped
+_LABEL_VALUE = r'(?:[^"\\\n]|\\\\|\\"|\\n)*'
+_LABEL = rf'{_LABEL_NAME}="{_LABEL_VALUE}"'
+_SAMPLE_RE = re.compile(
+    rf"^({_NAME})(?:\{{({_LABEL}(?:,{_LABEL})*)?\}})? (\S+)(?: \d+)?$"
+)
+_HELP_RE = re.compile(rf"^# HELP ({_NAME}) (.*)$")
+_TYPE_RE = re.compile(
+    rf"^# TYPE ({_NAME}) (counter|gauge|histogram|summary|untyped)$"
+)
+_LABEL_SPLIT_RE = re.compile(rf"({_LABEL_NAME})=\"({_LABEL_VALUE})\",?")
+
+_VALUE_TOKENS = {"+Inf", "-Inf", "NaN"}
+
+
+def _parse_value(token: str) -> float:
+    if token in _VALUE_TOKENS:
+        return float(token.replace("Inf", "inf").replace("NaN", "nan"))
+    return float(token)  # raises on malformed values -> test failure
+
+
+def _family_of(sample_name: str, typed: dict) -> str:
+    """The family a sample belongs to: histogram samples carry their
+    family's name plus a _bucket/_sum/_count suffix."""
+    for suffix in ("_bucket", "_sum", "_count"):
+        if sample_name.endswith(suffix):
+            base = sample_name[: -len(suffix)]
+            if typed.get(base) == "histogram":
+                return base
+    return sample_name
+
+
+def parse_exposition(text: str):
+    """Strict parse -> (samples, typed, helped). Raises AssertionError on
+    any grammar or ordering violation."""
+    assert text.endswith("\n"), "exposition must end with a newline"
+    typed: dict = {}
+    helped: dict = {}
+    samples = []  # (family, name, labels: dict, value)
+    family_order = []  # first-seen order of sample families
+    closed = set()  # families that already ended their contiguous block
+    last_family = None
+    for lineno, line in enumerate(text.splitlines(), 1):
+        assert line == line.rstrip(), f"trailing whitespace on line {lineno}"
+        assert line, f"blank line {lineno} inside exposition"
+        if line.startswith("# HELP"):
+            m = _HELP_RE.match(line)
+            assert m, f"malformed HELP line {lineno}: {line!r}"
+            name = m.group(1)
+            assert name not in helped, f"duplicate HELP for {name}"
+            assert name not in typed, f"HELP after TYPE for {name}"
+            helped[name] = m.group(2)
+            continue
+        if line.startswith("# TYPE"):
+            m = _TYPE_RE.match(line)
+            assert m, f"malformed TYPE line {lineno}: {line!r}"
+            name = m.group(1)
+            assert name not in typed, f"duplicate TYPE for {name}"
+            assert name not in closed and not any(
+                s[0] == name for s in samples
+            ), f"TYPE for {name} after its samples"
+            typed[name] = m.group(2)
+            continue
+        assert not line.startswith("#"), f"unknown comment line {lineno}"
+        m = _SAMPLE_RE.match(line)
+        assert m, f"malformed sample line {lineno}: {line!r}"
+        name, label_blob, value_token = m.group(1), m.group(2), m.group(3)
+        labels = {}
+        if label_blob:
+            consumed = 0
+            for lm in _LABEL_SPLIT_RE.finditer(label_blob):
+                assert lm.group(1) not in labels, (
+                    f"duplicate label {lm.group(1)} on line {lineno}"
+                )
+                labels[lm.group(1)] = lm.group(2)
+                consumed = lm.end()
+            assert consumed == len(label_blob), (
+                f"unparseable label residue on line {lineno}: {label_blob!r}"
+            )
+        value = _parse_value(value_token)
+        family = _family_of(name, typed)
+        if family != last_family:
+            assert family not in closed, (
+                f"family {family} samples are not contiguous (line {lineno})"
+            )
+            if last_family is not None:
+                closed.add(last_family)
+            family_order.append(family)
+            last_family = family
+        samples.append((family, name, labels, value))
+    return samples, typed, helped
+
+
+def _check_histograms(samples, typed):
+    """Per histogram family and label-set: le cumulative, ends +Inf,
+    _count == +Inf bucket, _sum present."""
+    hist_families = {n for n, t in typed.items() if t == "histogram"}
+    for fam in hist_families:
+        series: dict = {}
+        for family, name, labels, value in samples:
+            if family != fam:
+                continue
+            key = tuple(
+                sorted((k, v) for k, v in labels.items() if k != "le")
+            )
+            entry = series.setdefault(
+                key, {"buckets": [], "sum": None, "count": None}
+            )
+            if name.endswith("_bucket"):
+                assert "le" in labels, f"{fam} bucket without le"
+                le = labels["le"]
+                bound = (
+                    math.inf if le == "+Inf" else float(le)
+                )
+                entry["buckets"].append((bound, value))
+            elif name.endswith("_sum"):
+                entry["sum"] = value
+            elif name.endswith("_count"):
+                entry["count"] = value
+        assert series, f"histogram family {fam} rendered no samples"
+        for key, entry in series.items():
+            buckets = entry["buckets"]
+            assert buckets, f"{fam}{dict(key)} has no buckets"
+            bounds = [b for b, _ in buckets]
+            assert bounds == sorted(bounds), f"{fam} le bounds not sorted"
+            counts = [c for _, c in buckets]
+            assert counts == sorted(counts), (
+                f"{fam} bucket counts not cumulative"
+            )
+            assert bounds[-1] == math.inf, f"{fam} buckets must end at +Inf"
+            assert entry["sum"] is not None, f"{fam} missing _sum"
+            assert entry["count"] == counts[-1], (
+                f"{fam} _count != +Inf bucket"
+            )
+
+
+def _registry_with_traffic() -> MetricsRegistry:
+    reg = MetricsRegistry()
+    reg.record_request("upload", 200)
+    reg.record_request("upload", 404)
+    reg.record_request("path", 200)
+    # adversarial label values: quote, newline, backslash, brace
+    reg.record_request('evil"route}\n\\', 200)
+    reg.record_stage("decode", 0.004)
+    reg.record_stage("decode", 4.0)
+    reg.record_stage("device", 0.02)
+    reg.record_stage('we"ird\nstage\\', 0.01)
+    reg.record_cache(True)
+    reg.record_retry("fetch")
+    reg.record_breaker('host"with\nnasty\\chars:443', "open")
+    reg.record_shed("batch queue")
+    reg.record_deadline_hit("fetch")
+    reg.record_batch(3, 4)
+    reg.record_device_batch_seconds(0.015)
+    reg.record_compile_event(True)
+    reg.record_compile_event(False)
+    reg.gauge("flyimg_inflight_requests", "in flight").set(2)
+    reg.gauge("flyimg_cb", "callback", fn=lambda: 7)
+    return reg
+
+
+def test_exposition_conforms():
+    samples, typed, helped = parse_exposition(
+        _registry_with_traffic().render_prometheus()
+    )
+    # families that declared help must have declared a type first-seen
+    for name in helped:
+        assert name in typed, f"{name} has HELP but no TYPE"
+    _check_histograms(samples, typed)
+    # the adversarial label values survived as parseable escaped content
+    evil = [
+        labels for _, name, labels, _ in samples
+        if name == "flyimg_requests_total" and "evil" in labels.get("route", "")
+    ]
+    assert evil and evil[0]["route"] == 'evil\\"route}\\n\\\\'
+
+
+def test_exposition_values_parse_as_floats():
+    samples, _, _ = parse_exposition(
+        _registry_with_traffic().render_prometheus()
+    )
+    for _, name, _, value in samples:
+        assert isinstance(value, float) or isinstance(value, int), name
+
+
+def test_live_app_metrics_conform(tmp_path):
+    """The full app's /metrics output (after real traffic, including a 404
+    and an unmatched route) passes the same strict grammar."""
+    import asyncio
+
+    import numpy as np
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from flyimg_tpu.appconfig import AppParameters
+    from flyimg_tpu.codecs import encode
+    from flyimg_tpu.service.app import make_app
+
+    pytest.importorskip("aiohttp")
+    rng = np.random.default_rng(3)
+    src = tmp_path / "s.png"
+    src.write_bytes(
+        encode(rng.integers(0, 255, (32, 40, 3), dtype=np.uint8), "png")
+    )
+    params = AppParameters(
+        {
+            "tmp_dir": str(tmp_path / "t"),
+            "upload_dir": str(tmp_path / "u"),
+            "batch_deadline_ms": 1.0,
+        }
+    )
+
+    async def go():
+        app = make_app(params)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            await client.get(f"/upload/w_16,o_png/{src}")
+            await client.get("/upload/w_16/missing.png")  # 404
+            await client.get("/nosuchroute")              # unmatched
+            return await (await client.get("/metrics")).text()
+        finally:
+            await client.close()
+
+    loop = asyncio.new_event_loop()
+    try:
+        text = loop.run_until_complete(go())
+    finally:
+        loop.close()
+    samples, typed, _ = parse_exposition(text)
+    _check_histograms(samples, typed)
+    names = {name for _, name, _, _ in samples}
+    assert "flyimg_requests_total" in names
+    assert "flyimg_device_seconds_bucket" in names
+    assert typed.get("flyimg_batcher_queue_depth") == "gauge"

@@ -1,0 +1,154 @@
+"""CI observability smoke: boot the app on CPU, issue one traced request
+(with a scripted retried fetch so resilience span events are exercised),
+then assert `/metrics` parses under the strict exposition grammar and
+`/debug/traces/{id}` returns a well-formed span tree.
+
+    JAX_PLATFORMS=cpu python tools/smoke_observability.py
+
+Exit code 0 = every assertion held. This is smoke-level (one in-process
+app, one request) — the full behavioral matrix lives in
+tests/test_tracing.py and tests/test_prometheus_format.py; this script
+exists so CI proves the wired-together service emits the whole
+observability surface, not just that the units pass.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+import tempfile
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+# the strict exposition parser is shared with the conformance test —
+# one grammar, no drift between CI smoke and the unit suite
+sys.path.insert(0, os.path.join(REPO_ROOT, "tests"))
+
+from test_prometheus_format import _check_histograms, parse_exposition  # noqa: E402
+
+
+def _require(cond: bool, what: str) -> None:
+    if not cond:
+        print(f"FAIL: {what}", file=sys.stderr)
+        raise SystemExit(1)
+
+
+def _check_span_tree(node: dict, depth: int = 0) -> int:
+    """A well-formed span: name, ids, non-negative duration, recursively
+    well-formed children. Returns the span count."""
+    _require(isinstance(node.get("name"), str) and node["name"], "span name")
+    _require(
+        isinstance(node.get("span_id"), str) and len(node["span_id"]) == 16,
+        f"span_id of {node.get('name')}",
+    )
+    _require(
+        node.get("duration_s") is not None and node["duration_s"] >= 0,
+        f"duration of {node['name']}",
+    )
+    _require(depth < 32, "span tree depth runaway")
+    count = 1
+    for child in node.get("children", []):
+        _require(
+            child.get("parent_id") == node["span_id"],
+            f"parent link of {child.get('name')}",
+        )
+        count += _check_span_tree(child, depth + 1)
+    return count
+
+
+async def main() -> int:
+    import httpx
+    import numpy as np
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from flyimg_tpu.appconfig import AppParameters
+    from flyimg_tpu.codecs import encode
+    from flyimg_tpu.service.app import make_app
+    from flyimg_tpu.testing import faults
+
+    tmp = tempfile.mkdtemp(prefix="flyimg-smoke-")
+    png = encode(
+        np.random.default_rng(0).integers(
+            0, 255, (48, 64, 3), dtype=np.uint8
+        ),
+        "png",
+    )
+    # one transient fetch failure, then the real bytes: the request must
+    # succeed AND its trace must carry the retry span event
+    injector = faults.FaultInjector()
+    injector.plan(
+        "fetch.http",
+        faults.fail_n_then_succeed(
+            1, lambda: httpx.ConnectTimeout("injected"), result=png
+        ),
+    )
+    params = AppParameters(
+        {
+            "tmp_dir": os.path.join(tmp, "t"),
+            "upload_dir": os.path.join(tmp, "u"),
+            "debug": True,
+            "batch_deadline_ms": 1.0,
+            "fault_injector": injector,
+            "retry_base_backoff_s": 0.0,
+            "retry_max_backoff_s": 0.0,
+        }
+    )
+    app = make_app(params)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        tid, pid = "ab" * 16, "cd" * 8
+        resp = await client.get(
+            "/upload/w_32,h_24,o_png/http://smoke.example.com/img.png",
+            headers={"traceparent": f"00-{tid}-{pid}-01"},
+        )
+        _require(resp.status == 200, f"request status {resp.status}")
+        echoed = resp.headers.get("traceparent", "")
+        _require(
+            echoed.startswith(f"00-{tid}-"), f"traceparent echo {echoed!r}"
+        )
+        _require(
+            injector.fired.get("fetch.http", 0) == 2,
+            "fault plan fired twice (fail then succeed)",
+        )
+
+        # /metrics parses under the strict grammar, histograms coherent
+        metrics_text = await (await client.get("/metrics")).text()
+        samples, typed, _ = parse_exposition(metrics_text)
+        _check_histograms(samples, typed)
+        names = {name for _, name, _, _ in samples}
+        for expected in (
+            "flyimg_requests_total",
+            "flyimg_retries_total",
+            "flyimg_device_seconds_bucket",
+            "flyimg_compile_events_total",
+            "flyimg_inflight_requests",
+            "flyimg_batcher_queue_depth",
+        ):
+            _require(expected in names, f"metric family {expected}")
+
+        # the trace is retrievable and its span tree is well-formed
+        detail = await client.get(f"/debug/traces/{tid}")
+        _require(detail.status == 200, f"trace lookup {detail.status}")
+        tree = await detail.json()
+        _require(tree["trace_id"] == tid, "trace id")
+        _require(len(tree["spans"]) == 1, "single root span")
+        root = tree["spans"][0]
+        _require(root["parent_id"] == pid, "root joins inbound parent")
+        n_spans = _check_span_tree(root)
+        _require(n_spans >= 5, f"span tree size {n_spans}")
+        flat = repr(tree)
+        for needle in ("device_execute", "batch.occupancy", "'retry'"):
+            _require(needle in flat, f"trace contains {needle}")
+        print(
+            f"observability smoke OK: {n_spans} spans, "
+            f"{len(names)} metric families, retry event present"
+        )
+        return 0
+    finally:
+        await client.close()
+
+
+if __name__ == "__main__":
+    raise SystemExit(asyncio.run(main()))
