@@ -74,6 +74,25 @@ SERVER_DEFAULTS: Dict[str, Any] = {
     # sheds as 503
     "device_result_timeout_s": 120.0,
     "wedged_executor_fallback": True,
+    # --- device-batch failure containment (runtime/batcher.py;
+    # docs/resilience.md) ---
+    # transient batch failures (device runtime hiccups) re-execute the
+    # whole batch up to this many times with full-jitter backoff
+    "resilience_batch_retries": 2,
+    # poison batch failures (member-caused) re-execute by recursive
+    # bisection so innocent members succeed and only the poison member's
+    # request fails; off = whole-batch failure (pre-containment behavior)
+    "resilience_bisect_enable": True,
+    # isolated poison work is fingerprinted (plan key + image digest) and
+    # short-circuited to singleton execution for this long; 0 disables
+    "resilience_quarantine_ttl": 300.0,
+    # an executor thread stuck inside one batch longer than this is
+    # replaced (queued groups re-home to the new thread); 0 disables the
+    # wedge check (a DEAD executor thread is always replaced)
+    "resilience_executor_wedge_timeout_s": 60.0,
+    # bounded batcher drain on graceful shutdown (readiness flips to 503
+    # first so load balancers stop routing during the drain)
+    "shutdown_drain_timeout_s": 30.0,
     # --- observability knobs (runtime/tracing.py, runtime/logging.py;
     # docs/observability.md) ---
     # per-request tracing: spans for fetch/decode/batch-wait/device/encode/

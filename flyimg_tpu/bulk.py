@@ -70,19 +70,25 @@ def bulk_process(
     )
     params = AppParameters()
     own_batcher = batcher is None
+    from flyimg_tpu.runtime.batcher import containment_params
+
+    containment = containment_params(params)
     if own_batcher:
         # same tunables serving reads (service/app.py): an operator's
-        # batching config must mean the same thing in offline sweeps
+        # batching config must mean the same thing in offline sweeps —
+        # including the blast-radius containment knobs
         batcher = BatchController(
             max_batch=int(params.by_key("batch_max_size", 64)),
             deadline_ms=float(params.by_key("batch_deadline_ms", 4.0)),
             pipeline_depth=int(params.by_key("batch_pipeline_depth", 2)),
+            **containment,
         )
     # host codec work on its own controller so JPEG-decode pool batches
     # don't serialize against device launches (mirrors service/app.py)
     codec_batcher = BatchController(
         max_batch=int(params.by_key("decode_batch_max", 32)),
         deadline_ms=float(params.by_key("decode_deadline_ms", 1.0)),
+        **containment,
     )
     handler = ImageHandler(
         storage=None,  # transform_bytes never touches storage

@@ -23,10 +23,27 @@ launch batch N+1 while batch N's device->host read is still in flight.
 On real hardware that overlaps the D2H copy with compute; through the dev
 relay tunnel it overlaps the ~70 ms dispatch and ~50 ms result-read
 constants that otherwise serialize per batch (round-4 e2e measurement).
+
+Failure containment (docs/resilience.md): sharing a batch must not mean
+sharing its failures. A failed launch is classified
+(runtime/resilience.py classify_batch_error): TRANSIENT device/runtime
+errors get a bounded whole-batch retry with full-jitter backoff
+(``batch_retries``); member-caused POISON errors re-execute by recursive
+bisection down to singletons (``bisect_enable``), so innocent members
+still succeed and only the poison member's future fails. Fingerprints of
+isolated poison work (plan key + image digest) enter a TTL'd quarantine
+(``quarantine_ttl_s``); repeat offenders short-circuit to isolated
+singleton execution at submit time. The executor thread self-heals: a
+dead or wedged (``executor_wedge_timeout_s``) executor is detected at
+submit time and replaced, the new thread re-homing all queued groups —
+instead of permanently stranding submissions behind the handler's
+per-request CPU fallback.
 """
 
 from __future__ import annotations
 
+import hashlib
+import itertools
 import threading
 import time
 from concurrent.futures import Future
@@ -46,10 +63,46 @@ from flyimg_tpu.ops.compose import (
     plan_layout,
 )
 from flyimg_tpu.runtime import tracing
+from flyimg_tpu.runtime.resilience import (
+    POISON,
+    TRANSIENT,
+    QuarantineTable,
+    RetryPolicy,
+    classify_batch_error,
+)
 from flyimg_tpu.spec.plan import TransformPlan
 from flyimg_tpu.testing import faults
 
 MAX_BATCH_BUCKET = 64
+
+
+def containment_params(params) -> dict:
+    """The blast-radius containment kwargs ``BatchController`` reads from
+    appconfig — ONE mapping shared by serving (service/app.py) and
+    offline bulk sweeps (bulk.py), so the ``resilience_*`` knobs mean
+    the same thing everywhere (docs/resilience.md)."""
+    return dict(
+        batch_retries=int(params.by_key("resilience_batch_retries", 2)),
+        bisect_enable=bool(
+            params.by_key("resilience_bisect_enable", True)
+        ),
+        quarantine_ttl_s=float(
+            params.by_key("resilience_quarantine_ttl", 300.0)
+        ),
+        executor_wedge_timeout_s=float(
+            params.by_key("resilience_executor_wedge_timeout_s", 60.0)
+        ),
+    )
+
+
+def _image_digest(image) -> str:
+    """Quarantine fingerprint component for one member's pixels. Only
+    computed on the poison paths (isolation bookkeeping, and submit-time
+    checks while the quarantine table is non-empty) — never on the
+    fault-free hot path."""
+    return hashlib.blake2b(
+        np.ascontiguousarray(image).tobytes(), digest_size=12
+    ).hexdigest()
 
 
 def _round_batch(n: int) -> int:
@@ -103,6 +156,8 @@ class _Pending:       # ndarray fields ("truth value is ambiguous" in any
     # every member request's trace (runtime/tracing.py)
     trace: Optional[object] = None
     parent_span_id: Optional[str] = None
+    # lazily computed quarantine digest (poison paths only)
+    fp_digest: Optional[str] = None
 
 
 @dataclass
@@ -120,6 +175,11 @@ class _Group:
     # aux groups (e.g. batched smart-crop scoring) run this instead of the
     # vmapped transform program: runner(payloads) -> results, one per member
     runner: Optional[callable] = None
+    # quarantine fingerprints use the PROGRAM identity: quarantined
+    # submissions ride a nonce-suffixed key (forced singleton group), so
+    # the un-suffixed key is carried separately or a re-offender would be
+    # fingerprinted under a key no later submission can ever match
+    base_key: Optional[Tuple] = None
 
 
 class BatchController:
@@ -137,6 +197,10 @@ class BatchController:
         max_queue_depth: int = 0,
         shed_retry_after_s: float = 1.0,
         name: str = "device",
+        batch_retries: int = 2,
+        bisect_enable: bool = True,
+        quarantine_ttl_s: float = 0.0,
+        executor_wedge_timeout_s: float = 0.0,
     ) -> None:
         from flyimg_tpu.runtime.metrics import (
             MetricsRegistry,
@@ -179,6 +243,28 @@ class BatchController:
             "Pending (queued or executing) submissions per controller",
             fn=lambda: self.admission.pending,
         )
+        # failure containment (docs/resilience.md): bounded whole-batch
+        # retry for transient errors, bisection isolation for poison
+        # members, TTL'd quarantine of repeat offenders (0 = disabled)
+        self.batch_retries = max(0, int(batch_retries))
+        self.bisect_enable = bool(bisect_enable)
+        self.quarantine = (
+            QuarantineTable(quarantine_ttl_s)
+            if quarantine_ttl_s and quarantine_ttl_s > 0
+            else None
+        )
+        # backoff source for batch-level retries (full jitter, same policy
+        # the edge retries use); tests stub .sleep for determinism
+        self._retry_policy = RetryPolicy(
+            max_attempts=self.batch_retries + 1
+        )
+        # executor self-healing: a dead executor thread is always
+        # replaced at the next submission; a wedged one (inside _execute
+        # longer than this bound) is replaced too when the bound is > 0
+        self.executor_wedge_timeout_s = float(executor_wedge_timeout_s)
+        self._busy_since: Optional[float] = None
+        self._busy_owner: Optional[threading.Thread] = None
+        self._quarantine_seq = itertools.count()
         self._batch_seq = 0  # batch-id counter (executor thread only)
         self._groups: Dict[Tuple, _Group] = {}
         self._lock = threading.Condition()
@@ -193,6 +279,12 @@ class BatchController:
         self._pipeline_depth = max(1, int(pipeline_depth))
         self._inflight = threading.Semaphore(self._pipeline_depth)
         self._inflight_batches: List[List[_Pending]] = []
+        self._spawn_executor()
+
+    def _spawn_executor(self) -> None:
+        """Start (or, from self-healing, replace) THE executor thread.
+        ``self._thread`` identity doubles as the supersession marker:
+        a replaced thread notices ``self._thread is not me`` and exits."""
         self._thread = threading.Thread(
             target=self._run, name="flyimg-batcher", daemon=True
         )
@@ -280,17 +372,40 @@ class BatchController:
                 submit_span.span_id if submit_span is not None else None
             ),
         )
+        base_key = key
+        # quarantine short-circuit: recently-poison work executes as a
+        # forced singleton (nonce-suffixed key -> its own group) so a hot
+        # bad input cannot re-poison a fresh shared batch every tick. The
+        # full-image digest is only computed when THIS plan key has a
+        # live quarantine entry — unrelated submissions (and the
+        # fault-free hot path) pay one dict lookup.
+        if self.quarantine is not None and self.quarantine.has_prefix(
+            base_key
+        ):
+            pending.fp_digest = _image_digest(image)
+            if self.quarantine.hit((base_key, pending.fp_digest)):
+                self.metrics.record_quarantine_hit()
+                tracing.add_event(
+                    "quarantine.hit",
+                    controller=self.name,
+                    digest=pending.fp_digest,
+                )
+                key = base_key + (
+                    ("__quarantine__", next(self._quarantine_seq)),
+                )
+        group_key = key
         self._admit_and_enqueue(
-            key,
+            group_key,
             pending,
             lambda: _Group(
-                key=key,
+                key=group_key,
                 in_shape=in_shape,
                 resample_out=resample_out,
                 pad_canvas=layout.pad_canvas,
                 pad_offset=layout.pad_offset,
                 device_plan=device_plan,
                 rotate_dynamic=rotate_dynamic,
+                base_key=base_key,
             ),
         )
         return future
@@ -329,6 +444,7 @@ class BatchController:
                 pad_offset=(0, 0),
                 device_plan=None,
                 runner=runner,
+                base_key=full_key,
             ),
         )
         return future
@@ -347,6 +463,7 @@ class BatchController:
             with self._lock:
                 if self._stop:
                     raise RuntimeError("batcher is closed")
+                self._maybe_heal_executor_locked()
                 group = self._groups.get(key)
                 if group is None:
                     group = make_group()
@@ -357,6 +474,75 @@ class BatchController:
             if not pending.future.done():
                 self.admission.release()
             raise
+
+    def _maybe_heal_executor_locked(self) -> None:
+        """Executor self-healing, checked at every submission (caller
+        holds the lock): a DEAD executor thread (killed by a
+        BaseException escaping a batch) is always replaced; a WEDGED one
+        (inside _execute longer than ``executor_wedge_timeout_s``, e.g.
+        a device launch hung in the transport) is replaced when that
+        bound is set. The replacement re-homes every queued group —
+        ``self._groups`` is shared state, not thread state — so later
+        submissions stop stranding behind the per-request CPU fallback.
+        The superseded thread, if it ever unwedges, sees
+        ``self._thread is not me`` and exits; its in-flight futures
+        resolve normally (every resolution is done()-guarded)."""
+        if self._stop:
+            return
+        reason = None
+        if not self._thread.is_alive():
+            reason = "dead"
+        elif (
+            self.executor_wedge_timeout_s > 0
+            and self._busy_since is not None
+            and time.monotonic() - self._busy_since
+            > self.executor_wedge_timeout_s
+        ):
+            reason = "wedged"
+        if reason is None:
+            return
+        self.metrics.record_executor_restart(reason)
+        tracing.add_event(
+            "executor_restart", reason=reason, controller=self.name
+        )
+        if reason == "wedged":
+            # a thread wedged AFTER acquiring a pipeline slot (e.g. hung
+            # inside the device dispatch) never releases it; abandon the
+            # old semaphore with the wedged thread so the replacement
+            # gets full pipeline depth. Release paths release the
+            # semaphore instance they acquired, so late releases from
+            # superseded threads land on the abandoned object harmlessly.
+            # (A DEAD thread always released its slot on the way out —
+            # its semaphore stays live for the in-flight drain threads.)
+            self._inflight = threading.Semaphore(self._pipeline_depth)
+        self._busy_since = None
+        self._busy_owner = None
+        self._spawn_executor()
+
+    def _touch_busy(self) -> None:
+        """Refresh the wedge-detection progress clock. The wedge timeout
+        bounds time-without-progress, not total _execute time: a long
+        but healthy recovery (backoff sleeps + up to 2·log2 n bisection
+        launches, some compiling) must not read as wedged. Owner-guarded:
+        recovery launches running on DRAIN threads must not mask a
+        genuinely wedged executor."""
+        me = threading.current_thread()
+        with self._lock:
+            if self._busy_owner is me:
+                self._busy_since = time.monotonic()
+
+    def _suspend_busy(self) -> None:
+        """Pause the wedge clock across a compile-bearing dispatch: the
+        first call of a new program shape compiles synchronously and can
+        legitimately take tens of seconds to minutes — it must not read
+        as a wedge (a restart would spawn a second live executor and
+        swap the pipeline semaphore under a healthy one). Detection
+        re-arms at the next progress touch; a transport hang during a
+        compile-miss launch is still caught on any later launch."""
+        me = threading.current_thread()
+        with self._lock:
+            if self._busy_owner is me:
+                self._busy_since = None
 
     def stats(self) -> Dict[str, float]:
         summary = self.metrics.summary()
@@ -404,20 +590,60 @@ class BatchController:
     # ------------------------------------------------------------------
 
     def _run(self) -> None:
+        me = threading.current_thread()
         while True:
             group = None
             with self._lock:
+                if self._thread is not me:
+                    return  # superseded by executor self-healing
                 while not self._stop and not self._ready_group():
                     # wake at the earliest deadline among queued members
                     timeout = self._next_deadline()
                     self._lock.wait(timeout=timeout)
+                    if self._thread is not me:
+                        return
                 if self._stop and not any(
                     g.members for g in self._groups.values()
                 ):
                     return
                 group = self._pop_ready_group()
-            if group is not None:
+                if group is not None:
+                    # wedge detection base: how long THIS thread has been
+                    # inside _execute (cleared below, owner-guarded so a
+                    # replacement's accounting is never clobbered)
+                    self._busy_since = time.monotonic()
+                    self._busy_owner = me
+            if group is None:
+                continue
+            try:
                 self._execute(group)
+            except Exception as exc:  # pragma: no cover - _execute
+                # contains its own failure handling; this is the last
+                # line keeping the singleton executor alive
+                self._fail_members(group.members, exc)
+            except BaseException as exc:
+                # SystemExit/KeyboardInterrupt-class: the thread dies,
+                # but its batch must not die silently — and the next
+                # submission's heal check replaces the executor
+                self._fail_members(
+                    group.members,
+                    RuntimeError(f"batch executor died: {exc!r}"),
+                )
+                self._clear_busy(me)
+                raise
+            self._clear_busy(me)
+
+    def _clear_busy(self, me: threading.Thread) -> None:
+        with self._lock:
+            if self._busy_owner is me:
+                self._busy_since = None
+                self._busy_owner = None
+
+    @staticmethod
+    def _fail_members(members: List[_Pending], exc: BaseException) -> None:
+        for member in members:
+            if not member.future.done():
+                member.future.set_exception(exc)
 
     def _group_ready(self, group: _Group, now: float, total_pending: int) -> bool:
         """The ONE flush-readiness predicate (used by both the wait loop and
@@ -499,6 +725,7 @@ class BatchController:
             members=take,
             rotate_dynamic=group.rotate_dynamic,
             runner=group.runner,
+            base_key=group.base_key,
         )
         return ready
 
@@ -514,13 +741,19 @@ class BatchController:
                 member.trace.attach_shared(span_obj, member.parent_span_id)
 
     def _start_batch_span(self, name: str, n: int, batch: int,
-                          members: List[_Pending]):
+                          members: List[_Pending],
+                          seq: Optional[int] = None):
         """Mint the shared span for one batch launch — only when at least
-        one member is traced (the untraced path must stay free)."""
+        one member is traced (the untraced path must stay free). ``seq``
+        is the launch's captured batch id; concurrent recovery launches
+        share the counter, so reading it live could name the wrong
+        launch."""
         if not any(m.trace is not None for m in members):
             return None
         span_obj = tracing.Span(name)
-        span_obj.set_attribute("batch.id", self._batch_seq)
+        span_obj.set_attribute(
+            "batch.id", seq if seq is not None else self._batch_seq
+        )
         span_obj.set_attribute("batch.controller", self.name)
         span_obj.set_attribute("batch.occupancy", n)
         span_obj.set_attribute("batch.size", batch)
@@ -534,21 +767,31 @@ class BatchController:
     def _execute(self, group: _Group) -> None:
         members = group.members
         n = len(members)
-        self._batch_seq += 1  # executor thread only; unique per launch
+        # capture the id under the lock: drain-thread recovery launches
+        # share the counter, and the span attribute + profiler
+        # annotation below must name THIS launch, not whichever
+        # increment happened last
+        with self._lock:
+            self._batch_seq += 1
+            seq = self._batch_seq
         # fault hook: a blocking plan here wedges the executor thread —
-        # the scenario the handler's wedged-executor fallback defends
-        # against (flyimg_tpu/testing/faults.py). A RAISING plan must
-        # fail this group's futures, never the singleton executor thread
-        # (a dead executor would strand every later submission).
+        # the scenario the wedge-restart self-healing and the handler's
+        # CPU fallback defend against (flyimg_tpu/testing/faults.py). A
+        # RAISING plan routes through the same classify/retry/bisect
+        # recovery as a real launch failure.
         try:
             faults.fire("batcher.execute", key=group.key, n=n)
         except Exception as exc:
-            for member in members:
-                if not member.future.done():
-                    member.future.set_exception(exc)
+            self._recover(group, members, exc)
             return
         if group.runner is not None:
-            span_obj = self._start_batch_span("aux_execute", n, n, members)
+            # the wedge clock keeps running across the aux runner call
+            # (deliberate: aux batches are sub-second host codec work, so
+            # a long silence there IS the hung-native-pool wedge worth
+            # re-homing the queue over)
+            span_obj = self._start_batch_span(
+                "aux_execute", n, n, members, seq=seq
+            )
             if span_obj is not None:
                 span_obj.set_attribute(
                     "batch.runner", getattr(group.runner, "__name__", "aux")
@@ -575,7 +818,8 @@ class BatchController:
                     span_obj.end()
                     self._attach_batch_span(members, span_obj)
                 for member, result in zip(members, outputs):
-                    member.future.set_result(result)
+                    if not member.future.done():
+                        member.future.set_result(result)
             except Exception as exc:
                 if span_obj is not None:
                     span_obj.add_event(
@@ -583,74 +827,14 @@ class BatchController:
                     )
                     span_obj.end("error")
                     self._attach_batch_span(members, span_obj)
-                for member in members:
-                    if not member.future.done():
-                        member.future.set_exception(exc)
+                self._recover(group, members, exc)
             return
-        # sharded execution needs the batch divisible by the data axis —
-        # round the ladder size up to a multiple of it (device counts are
-        # not necessarily powers of two)
-        batch = _round_batch(n)
-        nd = self._n_devices
-        batch = -(-batch // nd) * nd
         span_obj = None
         try:
-            bh, bw = group.in_shape
-            # dynamic-rotate groups widen in_true with the host-computed
-            # rotated output extent (ops/compose.py make_program_fn)
-            true_w = 4 if group.rotate_dynamic else 2
-            images = np.zeros((batch, bh, bw, 3), dtype=np.uint8)
-            in_true = np.zeros((batch, true_w), dtype=np.float32)
-            span_y = np.zeros((batch, 2), dtype=np.float32)
-            span_x = np.zeros((batch, 2), dtype=np.float32)
-            out_true = np.zeros((batch, 2), dtype=np.float32)
-            for i, member in enumerate(members):
-                h, w = member.image.shape[:2]
-                if group.resample_out is None and (h, w) != (bh, bw):
-                    # pixel-op-only bucket: edge-replicate so convs stay
-                    # correct at the valid-region boundary
-                    images[i] = np.pad(
-                        member.image,
-                        ((0, bh - h), (0, bw - w), (0, 0)),
-                        mode="edge",
-                    )
-                else:
-                    images[i, :h, :w] = member.image
-                layout = plan_layout(member.plan)
-                in_true[i, :2] = (h, w)
-                if group.rotate_dynamic:
-                    in_true[i, 2:] = member.final_true
-                span_y[i] = layout.span_y
-                span_x[i] = layout.span_x
-                out_true[i] = layout.out_true
-            for i in range(n, batch):  # pad slots repeat the last member
-                images[i] = images[n - 1]
-                in_true[i] = in_true[n - 1]
-                span_y[i] = span_y[n - 1]
-                span_x[i] = span_x[n - 1]
-                out_true[i] = out_true[n - 1]
-
-            # profiling hook: an lru miss here means a NEW batched program
-            # was built — its first call is the XLA compile (possibly
-            # served from the persistent compilation cache, still the
-            # expensive path); a hit reuses an already-jitted callable
-            misses_before = build_batched_program.cache_info().misses
-            fn = build_batched_program(
-                batch,
-                group.in_shape,
-                group.resample_out,
-                group.pad_canvas,
-                group.pad_offset,
-                group.device_plan,
-                self.mesh,
-                group.rotate_dynamic,
-            )
-            compile_hit = (
-                build_batched_program.cache_info().misses == misses_before
-            )
-            self.metrics.record_compile_event(compile_hit)
+            batch, arrays = self._assemble(group, members)
+            fn, compile_hit = self._program(group, batch)
             span_obj = self._start_batch_span(
-                "device_execute", n, batch, members
+                "device_execute", n, batch, members, seq=seq
             )
             if span_obj is not None:
                 span_obj.set_attribute(
@@ -658,8 +842,17 @@ class BatchController:
                 )
                 span_obj.set_attribute("program.in_shape", str(group.in_shape))
             # bound the pipeline: at most pipeline_depth batches between
-            # dispatch and completed readback (memory + fairness)
-            self._inflight.acquire()
+            # dispatch and completed readback (memory + fairness).
+            # Capture the semaphore INSTANCE: wedge self-healing may swap
+            # self._inflight, and every release must land on the object
+            # this launch acquired from.
+            inflight = self._inflight
+            # waiting for a slot is backpressure, not a wedge: pause the
+            # clock so slow-but-alive drains (long recoveries, compiles)
+            # holding both slots cannot trigger a spurious restart
+            self._suspend_busy()
+            inflight.acquire()
+            self._touch_busy()
             try:
                 # asynchronous dispatch: returns once the launch is
                 # enqueued; pixels land later, read on a drain thread.
@@ -667,31 +860,29 @@ class BatchController:
                 # device traces (/debug/trace) so profiler timelines and
                 # request traces share the batch id.
                 t_dispatch = time.perf_counter()
-                with jax.profiler.TraceAnnotation(
-                    f"flyimg:batch:{self._batch_seq}"
-                ):
-                    dev_out = fn(
-                        jnp.asarray(images),
-                        jnp.asarray(in_true),
-                        jnp.asarray(span_y),
-                        jnp.asarray(span_x),
-                        jnp.asarray(out_true),
-                    )
+                if not compile_hit:
+                    self._suspend_busy()  # synchronous XLA compile ahead
+                with jax.profiler.TraceAnnotation(f"flyimg:batch:{seq}"):
+                    dev_out = fn(*(jnp.asarray(a) for a in arrays))
+                self._touch_busy()  # dispatch returned: progress
                 with self._lock:
                     self._inflight_batches.append(members)
                 threading.Thread(
                     target=self._drain,
-                    args=(members, dev_out, n, batch, t_dispatch, span_obj),
+                    args=(
+                        group, members, dev_out, n, batch, t_dispatch,
+                        span_obj, inflight,
+                    ),
                     name="flyimg-batcher-drain",
                     daemon=True,
                 ).start()
             except BaseException:
-                self._inflight.release()
+                inflight.release()
                 with self._lock:
                     if members in self._inflight_batches:
                         self._inflight_batches.remove(members)
                 raise
-        except Exception as exc:  # pragma: no cover - defensive
+        except Exception as exc:
             if span_obj is not None and span_obj.duration_s is None:
                 # dispatch failed after the span was minted: the errored
                 # span must still reach the member traces (tail sampling
@@ -701,15 +892,117 @@ class BatchController:
                 )
                 span_obj.end("error")
                 self._attach_batch_span(members, span_obj)
-            for member in members:
-                if not member.future.done():
-                    member.future.set_exception(exc)
+            self._recover(group, members, exc)
 
-    def _drain(self, members, dev_out, n: int, batch: int,
-               t_dispatch: Optional[float] = None, span_obj=None) -> None:
+    def _assemble(self, group: _Group, members: List[_Pending]):
+        """Padded host arrays for ONE launch of ``members`` (shared by
+        the pipelined primary path and the synchronous recovery path).
+        Fires the ``batcher.member`` fault point per member — an injected
+        raising plan models a poison member taking down the whole launch
+        (the real failure mode: the device cannot say WHICH input killed
+        a fused batch program)."""
+        n = len(members)
+        # sharded execution needs the batch divisible by the data axis —
+        # round the ladder size up to a multiple of it (device counts are
+        # not necessarily powers of two)
+        batch = _round_batch(n)
+        nd = self._n_devices
+        batch = -(-batch // nd) * nd
+        bh, bw = group.in_shape
+        # dynamic-rotate groups widen in_true with the host-computed
+        # rotated output extent (ops/compose.py make_program_fn)
+        true_w = 4 if group.rotate_dynamic else 2
+        images = np.zeros((batch, bh, bw, 3), dtype=np.uint8)
+        in_true = np.zeros((batch, true_w), dtype=np.float32)
+        span_y = np.zeros((batch, 2), dtype=np.float32)
+        span_x = np.zeros((batch, 2), dtype=np.float32)
+        out_true = np.zeros((batch, 2), dtype=np.float32)
+        for i, member in enumerate(members):
+            faults.fire(
+                "batcher.member",
+                key=group.key,
+                index=i,
+                image=member.image,
+            )
+            h, w = member.image.shape[:2]
+            if group.resample_out is None and (h, w) != (bh, bw):
+                # pixel-op-only bucket: edge-replicate so convs stay
+                # correct at the valid-region boundary
+                images[i] = np.pad(
+                    member.image,
+                    ((0, bh - h), (0, bw - w), (0, 0)),
+                    mode="edge",
+                )
+            else:
+                images[i, :h, :w] = member.image
+            layout = plan_layout(member.plan)
+            in_true[i, :2] = (h, w)
+            if group.rotate_dynamic:
+                in_true[i, 2:] = member.final_true
+            span_y[i] = layout.span_y
+            span_x[i] = layout.span_x
+            out_true[i] = layout.out_true
+        for i in range(n, batch):  # pad slots repeat the last member
+            images[i] = images[n - 1]
+            in_true[i] = in_true[n - 1]
+            span_y[i] = span_y[n - 1]
+            span_x[i] = span_x[n - 1]
+            out_true[i] = out_true[n - 1]
+        return batch, (images, in_true, span_y, span_x, out_true)
+
+    def _program(self, group: _Group, batch: int):
+        """Resolve the jitted batched program for one launch.
+        An lru miss here means a NEW batched program was built — its
+        first call is the XLA compile (possibly served from the
+        persistent compilation cache, still the expensive path); a hit
+        reuses an already-jitted callable."""
+        misses_before = build_batched_program.cache_info().misses
+        fn = build_batched_program(
+            batch,
+            group.in_shape,
+            group.resample_out,
+            group.pad_canvas,
+            group.pad_offset,
+            group.device_plan,
+            self.mesh,
+            group.rotate_dynamic,
+        )
+        compile_hit = (
+            build_batched_program.cache_info().misses == misses_before
+        )
+        self.metrics.record_compile_event(compile_hit)
+        return fn, compile_hit
+
+    def _resolve_members(self, group: _Group, members: List[_Pending],
+                         outputs) -> None:
+        """Resolve every member future from one launch's outputs.
+        done()-guarded THROUGHOUT: one already-settled/cancelled future
+        (client gone, shutdown race, a superseded executor finishing
+        late) must skip, not raise InvalidStateError mid-loop — which
+        previously diverted to the except path and wrongly failed every
+        remaining member of the batch."""
+        if group.runner is not None:
+            for member, result in zip(members, outputs):
+                if not member.future.done():
+                    member.future.set_result(result)
+            return
+        for i, member in enumerate(members):
+            result = outputs[i]
+            if member.needs_slice:
+                th, tw = member.final_true
+                result = result[: int(th), : int(tw)]
+            if not member.future.done():
+                member.future.set_result(np.ascontiguousarray(result))
+
+    def _drain(self, group: _Group, members, dev_out, n: int, batch: int,
+               t_dispatch: Optional[float] = None, span_obj=None,
+               inflight: Optional[threading.Semaphore] = None) -> None:
         """Blocking device->host read + future resolution for one
-        dispatched batch (runs on a daemon drain thread)."""
+        dispatched batch (runs on a daemon drain thread). ``inflight`` is
+        the pipeline semaphore instance this batch acquired from (the
+        live one unless wedge self-healing swapped it since)."""
         try:
+            faults.fire("batcher.drain", key=group.key, n=n, batch=batch)
             out = np.asarray(dev_out)
             device_s = (
                 time.perf_counter() - t_dispatch
@@ -727,12 +1020,7 @@ class BatchController:
                     )
                 self._attach_batch_span(members, span_obj)
             self.metrics.record_batch(n, batch)
-            for i, member in enumerate(members):
-                result = out[i]
-                if member.needs_slice:
-                    th, tw = member.final_true
-                    result = result[: int(th), : int(tw)]
-                member.future.set_result(np.ascontiguousarray(result))
+            self._resolve_members(group, members, out)
         except Exception as exc:
             if span_obj is not None and span_obj.duration_s is None:
                 # not yet ended -> the failure happened before the attach
@@ -742,11 +1030,187 @@ class BatchController:
                 )
                 span_obj.end("error")
                 self._attach_batch_span(members, span_obj)
-            for member in members:
-                if not member.future.done():
-                    member.future.set_exception(exc)
+            self._recover(group, members, exc)
         finally:
-            self._inflight.release()
+            (inflight if inflight is not None else self._inflight).release()
             with self._lock:
                 if members in self._inflight_batches:
                     self._inflight_batches.remove(members)
+
+    # ------------------------------------------------------------------
+    # failure containment: classify -> retry (transient) / bisect (poison)
+
+    def _recover(self, group: _Group, members: List[_Pending],
+                 exc: Exception) -> None:
+        """Blast-radius containment for one failed launch, dispatch OR
+        readback side (docs/resilience.md). Runs synchronously on the
+        calling thread (executor or drain): the device is the serial
+        resource either way, and recovery launches are bounded —
+        ``batch_retries`` for transient errors, O(2·log2 n) sub-batches
+        for bisection. With both knobs off this degrades to exactly the
+        pre-containment behavior: every member fails with ``exc``."""
+        live = [m for m in members if not m.future.done()]
+        if not live:
+            return
+        kind = classify_batch_error(exc)
+        span_obj = self._start_batch_span(
+            "batch_recovery", len(live), len(live), live
+        )
+        if span_obj is not None:
+            span_obj.set_attribute("recovery.error", type(exc).__name__)
+            span_obj.set_attribute("recovery.class", kind)
+        status = "ok"
+        try:
+            if kind == TRANSIENT and self.batch_retries > 0:
+                exc = self._retry_batch(group, live, exc, span_obj)
+                if exc is None:
+                    return  # a retry resolved every live member
+                # retries exhausted — or a retry surfaced a poison error
+                kind = classify_batch_error(exc)
+            if kind == POISON and self.bisect_enable:
+                if len(live) == 1:
+                    self._fail_poison(group, live[0], exc, span_obj)
+                else:
+                    self._bisect(group, live, span_obj)
+                return
+            status = "error"
+            self._fail_members(live, exc)
+        finally:
+            if span_obj is not None:
+                span_obj.end(status)
+                self._attach_batch_span(live, span_obj)
+
+    def _retry_batch(self, group: _Group, members: List[_Pending],
+                     first_exc: Exception, span_obj) -> Optional[Exception]:
+        """Bounded whole-batch retry with full-jitter backoff for
+        transient launch failures. Returns None when a retry resolved the
+        members, else the error to keep handling (the last transient one,
+        or the first non-transient one — handed straight to bisection)."""
+        last = first_exc
+        for attempt in range(1, self.batch_retries + 1):
+            delay = self._retry_policy.backoff(attempt)
+            self.metrics.record_batch_retry()
+            if span_obj is not None:
+                span_obj.add_event(
+                    "batch_retry",
+                    attempt=attempt,
+                    backoff_s=round(delay, 4),
+                    error=type(last).__name__,
+                )
+            if delay > 0:
+                self._retry_policy.sleep(delay)
+            try:
+                outputs = self._run_members(group, members)
+            except Exception as exc:
+                last = exc
+                if classify_batch_error(exc) != TRANSIENT:
+                    return exc
+                continue
+            self._resolve_members(group, members, outputs)
+            return None
+        return last
+
+    def _bisect(self, group: _Group, members: List[_Pending],
+                span_obj, depth: int = 0) -> None:
+        """Recursive bisection isolation: re-execute a failed batch as
+        two halves, recursing into whichever halves still fail, down to
+        singletons — innocent members resolve on the first passing
+        sub-batch, and only the poison member(s) fail. Worst case for one
+        poison in n members: 2·ceil(log2 n) extra launches."""
+        if span_obj is not None:
+            span_obj.add_event("batch_bisect", size=len(members), depth=depth)
+        mid = len(members) // 2
+        for part in (members[:mid], members[mid:]):
+            live = [m for m in part if not m.future.done()]
+            if not live:
+                continue
+            try:
+                outputs = self._run_members(group, live)
+            except Exception as exc:
+                if len(live) > 1:
+                    self._bisect(group, live, span_obj, depth + 1)
+                    continue
+                if (
+                    classify_batch_error(exc) == TRANSIENT
+                    and self.batch_retries > 0
+                ):
+                    # a device hiccup DURING recovery must not turn an
+                    # innocent singleton into a 5xx: give it the same
+                    # bounded retry a batch-level transient gets
+                    exc = self._retry_batch(group, live, exc, span_obj)
+                    if exc is None:
+                        continue
+                self._fail_poison(group, live[0], exc, span_obj)
+                continue
+            self._resolve_members(group, live, outputs)
+
+    def _fail_poison(self, group: _Group, member: _Pending,
+                     exc: Exception, span_obj) -> None:
+        """Terminal isolation of ONE member: the failure is request-
+        scoped (only this future errors, with the original exception so
+        the HTTP layer maps it as any other pipeline failure), and
+        poison-classified work is fingerprinted into quarantine so the
+        same input cannot re-poison a fresh shared batch within the TTL."""
+        digest = None
+        if classify_batch_error(exc) == POISON:
+            digest = self._quarantine_add(group, member)
+            self.metrics.record_poison_isolated()
+            if span_obj is not None:
+                span_obj.add_event(
+                    "poison_isolated",
+                    error=type(exc).__name__,
+                    digest=digest,
+                )
+        if not member.future.done():
+            member.future.set_exception(exc)
+
+    def _quarantine_add(self, group: _Group, member: _Pending):
+        """Fingerprint (base plan key + image digest) one isolated poison
+        member. Aux members (no plan/pixels contract) are not
+        fingerprintable; quarantine may be disabled entirely."""
+        if self.quarantine is None or member.plan is None:
+            return None
+        if member.fp_digest is None:
+            member.fp_digest = _image_digest(member.image)
+        self.quarantine.add(
+            (group.base_key or group.key, member.fp_digest)
+        )
+        return member.fp_digest
+
+    def _run_members(self, group: _Group, members: List[_Pending]):
+        """ONE synchronous launch (assemble -> dispatch -> blocking
+        readback) for the recovery paths; raises on failure, returns the
+        outputs for ``_resolve_members``. Successful recovery launches
+        count in the batch/occupancy metrics like primary launches do."""
+        with self._lock:  # drain-thread recoveries race the executor
+            self._batch_seq += 1
+            seq = self._batch_seq
+        self._touch_busy()  # each recovery launch is wedge-clock progress
+        n = len(members)
+        if group.runner is not None:
+            for i, member in enumerate(members):
+                faults.fire(
+                    "batcher.member",
+                    key=group.key,
+                    index=i,
+                    image=member.image,
+                )
+            outputs = group.runner([m.image for m in members])
+            if len(outputs) != n:
+                raise RuntimeError(
+                    f"aux runner returned {len(outputs)} results for "
+                    f"{n} payloads"
+                )
+            faults.fire("batcher.drain", key=group.key, n=n, batch=n)
+            return outputs
+        batch, arrays = self._assemble(group, members)
+        fn, compile_hit = self._program(group, batch)
+        if not compile_hit:
+            self._suspend_busy()  # synchronous XLA compile ahead
+        with jax.profiler.TraceAnnotation(f"flyimg:batch:{seq}"):
+            dev_out = fn(*(jnp.asarray(a) for a in arrays))
+        self._touch_busy()  # dispatch returned: progress
+        faults.fire("batcher.drain", key=group.key, n=n, batch=batch)
+        out = np.asarray(dev_out)
+        self.metrics.record_batch(n, batch)
+        return out

@@ -265,6 +265,40 @@ class MetricsRegistry:
             "Requests that exhausted their latency budget, by stage",
         ).inc()
 
+    # -- batch failure-containment counters (runtime/batcher.py;
+    # docs/resilience.md) --------------------------------------------------
+
+    def record_batch_retry(self) -> None:
+        self.counter(
+            "flyimg_batch_retries_total",
+            "Whole-batch re-executions after transient device failures",
+        ).inc()
+
+    def record_poison_isolated(self) -> None:
+        self.counter(
+            "flyimg_poison_isolated_total",
+            "Poison batch members isolated by bisection (innocents saved)",
+        ).inc()
+
+    def record_quarantine_hit(self) -> None:
+        self.counter(
+            "flyimg_quarantine_hits_total",
+            "Submissions short-circuited by the poison quarantine table",
+        ).inc()
+
+    def record_executor_restart(self, reason: str) -> None:
+        self.counter(
+            "flyimg_executor_restarts_total"
+            f'{{reason="{escape_label_value(reason)}"}}',
+            "Batch executor threads replaced by self-healing (dead/wedged)",
+        ).inc()
+
+    def record_cache_corrupt(self) -> None:
+        self.counter(
+            "flyimg_cache_corrupt_total",
+            "Cached outputs that failed read-time integrity validation",
+        ).inc()
+
     def record_batch(self, images: int, capacity: int) -> None:
         self.counter(
             "flyimg_batches_total", "Device batches executed"

@@ -23,6 +23,13 @@ serving tails):
   full, new work is rejected immediately (``ServiceUnavailableException``
   with ``retry_after_s`` -> 503 + Retry-After) so overload degrades to
   fast rejections instead of collapse.
+- ``classify_batch_error`` / ``QuarantineTable``: the device-batch
+  failure-containment primitives (docs/resilience.md). A shared padded
+  batch couples failures — one poison member would fail every innocent
+  co-member — so the batcher classifies batch errors (transient runtime
+  hiccup vs member-caused "poison"), retries or bisects accordingly, and
+  quarantines fingerprints of recently-poison work so a hot bad input
+  cannot re-poison fresh batches every tick.
 
 Everything is plain threading + monotonic time — usable from the aiohttp
 executor threads, the batcher, and offline bulk runs alike. Knobs surface
@@ -52,7 +59,11 @@ __all__ = [
     "BreakerRegistry",
     "CircuitOpenException",
     "AdmissionGate",
+    "QuarantineTable",
+    "classify_batch_error",
     "host_of",
+    "TRANSIENT",
+    "POISON",
 ]
 
 
@@ -464,3 +475,157 @@ class AdmissionGate:
     def pending(self) -> int:
         with self._lock:
             return self._pending
+
+
+# ---------------------------------------------------------------------------
+# Device-batch failure containment (runtime/batcher.py; docs/resilience.md)
+
+#: batch-error classes: a TRANSIENT error is a property of the device /
+#: runtime moment (retrying the same batch can succeed); a POISON error is
+#: a property of some member's input (retrying whole fails identically —
+#: only bisection down to the offending member helps)
+TRANSIENT = "transient"
+POISON = "poison"
+
+# plain-Python transport/IO failures: the device runtime's host side
+# (TimeoutError/ConnectionError are OSError subclasses; listed for clarity)
+_TRANSIENT_EXC_TYPES = (OSError, TimeoutError, ConnectionError)
+
+# XLA/JAX runtime errors carry an absl status code in the message. Codes
+# that indicate the INPUT (or the program built from it) is at fault —
+# including RESOURCE_EXHAUSTED: bisection halves the batch footprint, so
+# treating OOM as poison lets the innocent halves complete and pins the
+# failure on the smallest set that still overflows.
+_POISON_STATUS_MARKERS = (
+    "INVALID_ARGUMENT",
+    "RESOURCE_EXHAUSTED",
+    "FAILED_PRECONDITION",
+    "OUT_OF_RANGE",
+    "UNIMPLEMENTED",
+)
+
+
+def classify_batch_error(exc: BaseException) -> str:
+    """Classify one device-batch failure as ``TRANSIENT`` or ``POISON``.
+
+    XLA runtime errors (matched by MRO class name — the concrete type's
+    import location moves between jaxlib versions) are transient unless
+    their status code marks the program/input at fault; host-side IO
+    errors are transient; everything else — ValueError from assembly,
+    injected member faults, arbitrary library errors — defaults to poison
+    so bisection can localize it. A wrong poison default costs bounded
+    extra launches and converges to the same per-member failure; a wrong
+    transient default would burn retries re-executing a deterministic
+    failure against the whole batch.
+    """
+    names = {cls.__name__ for cls in type(exc).__mro__}
+    if "XlaRuntimeError" in names or "JaxRuntimeError" in names:
+        msg = str(exc).upper()
+        if any(marker in msg for marker in _POISON_STATUS_MARKERS):
+            return POISON
+        return TRANSIENT
+    if isinstance(exc, _TRANSIENT_EXC_TYPES):
+        return TRANSIENT
+    return POISON
+
+
+class QuarantineTable:
+    """TTL'd fingerprint table of recently-poison work.
+
+    Fingerprints are two-part ``(prefix, suffix)`` tuples — the batcher
+    uses (plan key, image digest) — stored as a two-level index so a
+    submitter can ask the CHEAP question first: ``has_prefix(plan_key)``
+    is a dict lookup, and only an implicated plan key pays the
+    full-image digest needed for the exact ``hit`` check. A hit means
+    "this exact work recently poisoned a batch" and the submitter
+    short-circuits it to isolated singleton execution so a hot bad URL
+    cannot re-poison a fresh shared batch every tick. Entries expire
+    after ``ttl_s``; the table is size-bounded (oldest-expiry eviction)
+    so an attacker cycling poison inputs cannot grow memory.
+    Thread-safe; clock injectable for deterministic TTL tests.
+    """
+
+    def __init__(
+        self,
+        ttl_s: float,
+        *,
+        max_entries: int = 1024,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.ttl_s = float(ttl_s)
+        self.max_entries = max(1, int(max_entries))
+        self._clock = clock
+        self._lock = threading.Lock()
+        # prefix -> {suffix: expires_at}
+        self._entries: Dict[object, Dict[object, float]] = {}
+        self._count = 0
+
+    def add(self, fingerprint) -> None:
+        prefix, suffix = fingerprint
+        with self._lock:
+            now = self._clock()
+            bucket = self._entries.setdefault(prefix, {})
+            if suffix not in bucket and self._count >= self.max_entries:
+                self._purge_locked(now)
+                if self._count >= self.max_entries:
+                    self._evict_oldest_locked()
+                bucket = self._entries.setdefault(prefix, {})
+            if suffix not in bucket:
+                self._count += 1
+            bucket[suffix] = now + self.ttl_s
+
+    def hit(self, fingerprint) -> bool:
+        prefix, suffix = fingerprint
+        with self._lock:
+            bucket = self._entries.get(prefix)
+            if bucket is None:
+                return False
+            expires_at = bucket.get(suffix)
+            if expires_at is None:
+                return False
+            if self._clock() >= expires_at:
+                self._remove_locked(prefix, suffix)
+                return False
+            return True
+
+    def has_prefix(self, prefix) -> bool:
+        """Any live entry under ``prefix``? The submit-path gate: a miss
+        here costs one dict lookup and skips the digest entirely."""
+        with self._lock:
+            bucket = self._entries.get(prefix)
+            if bucket is None:
+                return False
+            now = self._clock()
+            for suffix, expires_at in list(bucket.items()):
+                if now >= expires_at:
+                    self._remove_locked(prefix, suffix)
+            return prefix in self._entries
+
+    def _remove_locked(self, prefix, suffix) -> None:
+        bucket = self._entries.get(prefix)
+        if bucket is not None and suffix in bucket:
+            del bucket[suffix]
+            self._count -= 1
+            if not bucket:
+                del self._entries[prefix]
+
+    def _purge_locked(self, now: float) -> None:
+        for prefix in list(self._entries):
+            for suffix, expires_at in list(self._entries[prefix].items()):
+                if now >= expires_at:
+                    self._remove_locked(prefix, suffix)
+
+    def _evict_oldest_locked(self) -> None:
+        oldest = None
+        for prefix, bucket in self._entries.items():
+            for suffix, expires_at in bucket.items():
+                if oldest is None or expires_at < oldest[2]:
+                    oldest = (prefix, suffix, expires_at)
+        if oldest is not None:
+            self._remove_locked(oldest[0], oldest[1])
+
+    def __len__(self) -> int:
+        """Live (unexpired) entries (purges as a side effect)."""
+        with self._lock:
+            self._purge_locked(self._clock())
+            return self._count

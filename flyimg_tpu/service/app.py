@@ -9,7 +9,8 @@ app. Routes preserved exactly:
     GET /path/{options}/{imageSrc:.+}       -> public URL of the stored file
 
 plus the observability surface (docs/observability.md): /metrics,
-/healthz, and — debug-gated — /debug/trace (jax.profiler capture),
+/healthz (liveness), /readyz (readiness — 503 while draining for
+shutdown), and — debug-gated — /debug/trace (jax.profiler capture),
 /debug/traces (tail-sampled trace ring), /debug/traces/{id} (span tree).
 
 plus the ``encrypt`` CLI subcommand (reference app.php:93-96):
@@ -202,6 +203,12 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
     # controller; over it, requests shed as 503 + Retry-After instead of
     # queueing into collapse (runtime/resilience.py). 0 = unbounded.
     shed_retry_after = float(params.by_key("shed_retry_after_s", 1.0))
+    # blast-radius containment knobs shared by both controllers — the
+    # same mapping bulk sweeps read (runtime/batcher.py
+    # containment_params; docs/resilience.md)
+    from flyimg_tpu.runtime.batcher import containment_params
+
+    containment = containment_params(params)
     batcher = BatchController(
         max_batch=int(params.by_key("batch_max_size", 64)),
         deadline_ms=float(params.by_key("batch_deadline_ms", 4.0)),
@@ -211,6 +218,7 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
         max_queue_depth=int(params.by_key("batch_max_queue_depth", 0)),
         shed_retry_after_s=shed_retry_after,
         name="device",
+        **containment,
     )
     # host codec work gets its OWN controller/thread: JPEG-miss decode
     # batches (native DecodePool) must not serialize with device launches
@@ -221,6 +229,7 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
         max_queue_depth=int(params.by_key("decode_max_queue_depth", 0)),
         shed_retry_after_s=shed_retry_after,
         name="codec",
+        **containment,
     )
     # fault-injection hook (flyimg_tpu/testing/faults.py): tests assemble
     # a full app with scripted faults at named pipeline points; absent in
@@ -339,9 +348,24 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
     app[METRICS_KEY] = metrics
     app[TRACER_KEY] = tracer
 
+    # readiness vs liveness: /healthz answers "is the process + device
+    # runtime up", /readyz answers "should a load balancer route here".
+    # Graceful shutdown flips readiness FIRST (aiohttp runs on_shutdown
+    # before on_cleanup), so LBs stop routing while the batcher drains
+    # in-flight device work instead of feeding a dying instance.
+    draining = {"flag": False}
+
+    async def _begin_drain(_app):
+        draining["flag"] = True
+
+    app.on_shutdown.append(_begin_drain)
+
+    drain_timeout_s = float(params.by_key("shutdown_drain_timeout_s", 30.0))
+
     async def _close_batcher(_app):
-        batcher.close()
-        codec_batcher.close()
+        draining["flag"] = True  # direct-cleanup callers flip it too
+        batcher.close(drain_timeout_s)
+        codec_batcher.close(drain_timeout_s)
         if injector is not None:
             from flyimg_tpu.testing import faults
 
@@ -471,6 +495,22 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
             content_type="application/json",
         )
 
+    async def readyz(_request: web.Request) -> web.Response:
+        """Readiness (distinct from /healthz liveness): 503 while the app
+        is draining for shutdown so load balancers pull this instance out
+        of rotation before the batcher drain runs."""
+        import json as _json
+
+        if draining["flag"]:
+            return web.Response(
+                text=_json.dumps({"status": "draining"}), status=503,
+                content_type="application/json",
+            )
+        return web.Response(
+            text=_json.dumps({"status": "ok"}),
+            content_type="application/json",
+        )
+
     trace_lock = asyncio.Lock()
 
     async def debug_trace(request: web.Request) -> web.Response:
@@ -557,6 +597,7 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
     app.router.add_get("/", index)
     app.router.add_get("/metrics", metrics_route)
     app.router.add_get("/healthz", healthz)
+    app.router.add_get("/readyz", readyz)
     app.router.add_get("/debug/trace", debug_trace)
     app.router.add_get("/debug/traces", debug_traces_list)
     app.router.add_get("/debug/traces/{trace_id}", debug_traces_get)

@@ -25,12 +25,17 @@ import numpy as np
 
 from flyimg_tpu.appconfig import AppParameters
 from flyimg_tpu.codecs import decode, encode, media_info
+from flyimg_tpu.codecs.sniff import sniff
 from flyimg_tpu.exceptions import ServiceUnavailableException
 from flyimg_tpu.ops.compose import run_plan
 from flyimg_tpu.runtime import tracing
 from flyimg_tpu.runtime.resilience import Deadline
 from flyimg_tpu.service.input_source import FetchPolicy, load_source
-from flyimg_tpu.service.output_image import OutputSpec, resolve_output
+from flyimg_tpu.service.output_image import (
+    EXT_TO_MIME,
+    OutputSpec,
+    resolve_output,
+)
 from flyimg_tpu.service.security import SecurityHandler
 from flyimg_tpu.spec.options import OptionsBag
 from flyimg_tpu.spec.plan import (
@@ -227,6 +232,20 @@ class ImageHandler:
         # has/read/head calls would tax S3 serving's hot path 2-3x)
         with tracing.span("storage", op="fetch"):
             cached = None if refresh else self.storage.fetch(spec.name)
+        if cached is not None and not _cache_entry_valid(cached[0], spec):
+            # corrupt/truncated entry (torn write, disk damage, bucket
+            # tampering): treat it as a miss — delete and re-render —
+            # instead of serving garbage bytes under image headers
+            tracing.add_event(
+                "cache.corrupt", key=spec.name, bytes=len(cached[0])
+            )
+            if self.metrics is not None:
+                self.metrics.record_cache_corrupt()
+            try:
+                self.storage.delete(spec.name)
+            except Exception:
+                pass  # best effort; the re-render overwrites it anyway
+            cached = None
         if cached is not None:
             content, stat = cached
             tracing.add_event("cache.hit", key=spec.name)
@@ -904,6 +923,21 @@ class ImageHandler:
                 f"{len(content)}B"
             )
         return content
+
+
+def _cache_entry_valid(content: bytes, spec: OutputSpec) -> bool:
+    """Read-time integrity check for a cached output: non-empty and the
+    leading magic bytes sniff to the container the name promises. Every
+    servable output extension (png/jpg/gif/webp) is sniffable
+    (codecs/sniff.py), so a mismatch can only mean corruption — an
+    unknown extension (future formats) fails open rather than turning
+    every hit into a re-render."""
+    if not content:
+        return False
+    expected = EXT_TO_MIME.get(spec.extension)
+    if expected is None:
+        return True
+    return sniff(content).mime == expected
 
 
 @dataclass

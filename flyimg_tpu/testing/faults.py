@@ -12,7 +12,20 @@ through:
     ``storage.read``    one storage fetch/read attempt
     ``storage.write``   one storage write attempt
     ``batcher.execute`` the batch executor about to run a group — a
-                        blocking plan wedges the device executor
+                        blocking plan wedges the device executor; a
+                        raising plan routes through the batcher's
+                        classify/retry/bisect recovery
+    ``batcher.member``  one member being assembled into a device launch
+                        (primary AND recovery sub-launches), with
+                        per-member ctx ``key``/``index``/``image`` — a
+                        plan raising for one member models a poison
+                        input failing the whole fused launch, which the
+                        batcher then isolates by bisection
+                        (docs/resilience.md)
+    ``batcher.drain``   one device->host readback (primary drain thread
+                        and recovery launches), ctx ``key``/``n``/
+                        ``batch`` — raising models a transient readback
+                        failure, retried at the batch level
 
 Production cost is one module-level ``None`` check per point (no injector
 installed -> ``fire`` returns ``PASS`` immediately). Tests install a
@@ -40,6 +53,7 @@ __all__ = [
     "fail_n_then_succeed",
     "latency_spike",
     "wedge_until",
+    "poison_member",
 ]
 
 #: sentinel: "no plan fired — run the real code path"
@@ -136,6 +150,23 @@ def latency_spike(seconds: float, then=PASS) -> Callable:
         ):
             raise then
         return then
+
+    return plan
+
+
+def poison_member(match: Callable[..., bool],
+                  exc_factory: Callable[[], BaseException]) -> Callable:
+    """A ``batcher.member`` plan: raise ``exc_factory()`` whenever
+    ``match(**ctx)`` is truthy (ctx carries ``key``/``index``/``image``),
+    else fall through — THE deterministic poison pill. The raise happens
+    at launch-assembly time, so the whole fused batch fails exactly like
+    a real member-caused device error and the batcher must bisect to
+    find the offender."""
+
+    def plan(**ctx):
+        if match(**ctx):
+            raise exc_factory()
+        return PASS
 
     return plan
 

@@ -1,0 +1,487 @@
+"""Blast-radius isolation tests for the shared device batch path
+(docs/resilience.md): one poison member must fail alone, transient device
+errors must retry, repeat offenders must quarantine, and a dead/wedged
+executor must self-heal — all driven by the deterministic fault harness
+(flyimg_tpu/testing/faults.py), no real device flakiness involved.
+
+Acceptance behaviors pinned here (ISSUE 3):
+- a batch of 8 with 1 injected poison member resolves 7 futures and
+  fails exactly 1 (bisection enabled),
+- with bisection disabled the same batch fails whole (legacy behavior),
+- bisection converges within the O(n log n) member-launch cost bound,
+- quarantine entries expire after their TTL,
+- a transient failure retries then succeeds,
+- a dead or wedged executor thread is replaced and queued work re-homes.
+"""
+
+import math
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from flyimg_tpu.ops.compose import run_plan
+from flyimg_tpu.runtime.batcher import BatchController, _image_digest
+from flyimg_tpu.runtime.metrics import MetricsRegistry
+from flyimg_tpu.runtime.resilience import (
+    POISON,
+    TRANSIENT,
+    QuarantineTable,
+    classify_batch_error,
+)
+from flyimg_tpu.spec.options import OptionsBag
+from flyimg_tpu.spec.plan import build_plan
+from flyimg_tpu.testing import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.clear()
+
+
+SRC = (32, 32)  # one shape bucket -> every submission shares a group
+MARKER = np.array([255, 0, 255], dtype=np.uint8)
+
+
+def _plan(opts="w_16"):
+    return build_plan(OptionsBag(opts), *SRC)
+
+
+def _img(seed, poison=False):
+    rng = np.random.default_rng(seed)
+    img = rng.integers(0, 200, (SRC[1], SRC[0], 3), dtype=np.uint8)
+    img[0, 0] = MARKER if poison else (0, 0, 0)
+    return img
+
+
+def _is_poison(image=None, **_ctx):
+    return (
+        getattr(image, "ndim", 0) == 3 and bool(np.all(image[0, 0] == MARKER))
+    )
+
+
+def _poison_plan(exc_factory=lambda: ValueError("poison pixel")):
+    return faults.poison_member(_is_poison, exc_factory)
+
+
+def _ctl(**over):
+    kw = dict(
+        max_batch=8, deadline_ms=10_000.0, lone_flush=False,
+        quarantine_ttl_s=60.0, metrics=MetricsRegistry(),
+    )
+    kw.update(over)
+    ctl = BatchController(**kw)
+    ctl._retry_policy.sleep = lambda _s: None  # deterministic, no backoff
+    return ctl
+
+
+# ---------------------------------------------------------------------------
+# classification
+
+
+def test_classification_transient_vs_poison():
+    assert classify_batch_error(OSError("io hiccup")) == TRANSIENT
+    assert classify_batch_error(TimeoutError("slow")) == TRANSIENT
+    assert classify_batch_error(ConnectionResetError("reset")) == TRANSIENT
+    # unknown errors default to poison: bisection localizes them at a
+    # bounded cost, while a wrong transient call would burn retries
+    assert classify_batch_error(ValueError("bad member")) == POISON
+    assert classify_batch_error(RuntimeError("weird")) == POISON
+
+    class XlaRuntimeError(RuntimeError):
+        pass
+
+    assert classify_batch_error(
+        XlaRuntimeError("UNAVAILABLE: device lost")
+    ) == TRANSIENT
+    assert classify_batch_error(
+        XlaRuntimeError("INVALID_ARGUMENT: bad shape")
+    ) == POISON
+    assert classify_batch_error(
+        XlaRuntimeError("RESOURCE_EXHAUSTED: hbm oom")
+    ) == POISON
+
+
+# ---------------------------------------------------------------------------
+# bisection isolation (the acceptance batch-of-8)
+
+
+def test_poison_member_isolated_in_batch_of_8():
+    """8 concurrent submissions, 1 poison: 7 resolve pixel-identical to
+    the single-image path, exactly 1 fails — request-scoped."""
+    faults.install(faults.FaultInjector()).plan(
+        "batcher.member", _poison_plan()
+    )
+    ctl = _ctl()
+    try:
+        images = [_img(i, poison=(i == 3)) for i in range(8)]
+        futures = [ctl.submit(img, _plan()) for img in images]
+        for i, (img, fut) in enumerate(zip(images, futures)):
+            if i == 3:
+                with pytest.raises(ValueError, match="poison pixel"):
+                    fut.result(timeout=120)
+            else:
+                np.testing.assert_array_equal(
+                    fut.result(timeout=120), run_plan(img, _plan())
+                )
+        summary = ctl.metrics.summary()
+        assert summary["flyimg_poison_isolated_total"] == 1
+        assert "flyimg_batch_retries_total" not in summary  # not a retry
+        assert len(ctl.quarantine) == 1
+    finally:
+        ctl.close()
+
+
+def test_bisect_disabled_fails_whole_batch():
+    """The knob off restores today's whole-batch failure coupling."""
+    faults.install(faults.FaultInjector()).plan(
+        "batcher.member", _poison_plan()
+    )
+    ctl = _ctl(bisect_enable=False)
+    try:
+        futures = [
+            ctl.submit(_img(i, poison=(i == 3)), _plan()) for i in range(8)
+        ]
+        for fut in futures:
+            with pytest.raises(ValueError, match="poison pixel"):
+                fut.result(timeout=120)
+        assert "flyimg_poison_isolated_total" not in ctl.metrics.summary()
+    finally:
+        ctl.close()
+
+
+def test_bisection_convergence_cost_bound():
+    """One poison among n costs at most ~2*log2(n) extra sub-launches;
+    the per-member fault point fires once per member per launch, so its
+    count bounds the total assembly work."""
+    injector = faults.install(faults.FaultInjector())
+    injector.plan("batcher.member", _poison_plan())
+    n = 8
+    ctl = _ctl(max_batch=n)
+    try:
+        futures = [
+            ctl.submit(_img(i, poison=(i == 5)), _plan()) for i in range(n)
+        ]
+        done = [f for i, f in enumerate(futures) if i != 5]
+        for fut in done:
+            assert fut.result(timeout=120).shape == (16, 16, 3)
+        with pytest.raises(ValueError):
+            futures[5].result(timeout=120)
+        fired = injector.fired.get("batcher.member", 0)
+        assert fired <= n * (int(math.log2(n)) + 2)
+    finally:
+        ctl.close()
+
+
+def test_two_poison_members_both_isolated():
+    faults.install(faults.FaultInjector()).plan(
+        "batcher.member", _poison_plan()
+    )
+    ctl = _ctl()
+    try:
+        futures = [
+            ctl.submit(_img(i, poison=(i in (1, 6))), _plan())
+            for i in range(8)
+        ]
+        for i, fut in enumerate(futures):
+            if i in (1, 6):
+                with pytest.raises(ValueError):
+                    fut.result(timeout=120)
+            else:
+                assert fut.result(timeout=120).shape == (16, 16, 3)
+        assert ctl.metrics.summary()["flyimg_poison_isolated_total"] == 2
+        assert len(ctl.quarantine) == 2
+    finally:
+        ctl.close()
+
+
+def test_aux_group_poison_bisected():
+    """Aux (runner) groups get the same containment: a runner poisoned by
+    one payload still serves the other members."""
+
+    def runner(payloads):
+        if any(p == "poison" for p in payloads):
+            raise ValueError("aux poison")
+        return [p.upper() for p in payloads]
+
+    ctl = _ctl(max_batch=4)
+    try:
+        futures = [
+            ctl.submit_aux(("t",), p, runner)
+            for p in ("a", "poison", "c", "d")
+        ]
+        assert futures[0].result(timeout=60) == "A"
+        with pytest.raises(ValueError, match="aux poison"):
+            futures[1].result(timeout=60)
+        assert futures[2].result(timeout=60) == "C"
+        assert futures[3].result(timeout=60) == "D"
+        # aux members carry no plan/pixel contract -> never quarantined
+        assert len(ctl.quarantine) == 0
+    finally:
+        ctl.close()
+
+
+# ---------------------------------------------------------------------------
+# transient retry
+
+
+def test_transient_drain_failure_retries_then_succeeds():
+    faults.install(faults.FaultInjector()).plan(
+        "batcher.drain",
+        faults.fail_n_then_succeed(2, lambda: OSError("flaky readback")),
+    )
+    ctl = _ctl(batch_retries=2)
+    try:
+        futures = [ctl.submit(_img(i), _plan()) for i in range(4)]
+        for fut in futures:
+            assert fut.result(timeout=120).shape == (16, 16, 3)
+        summary = ctl.metrics.summary()
+        assert summary["flyimg_batch_retries_total"] == 2
+        assert "flyimg_poison_isolated_total" not in summary
+        assert len(ctl.quarantine) == 0
+    finally:
+        ctl.close()
+
+
+def test_transient_retries_exhausted_fail_whole_batch():
+    faults.install(faults.FaultInjector()).plan(
+        "batcher.drain",
+        faults.fail_n_then_succeed(99, lambda: OSError("dead readback")),
+    )
+    ctl = _ctl(batch_retries=2)
+    try:
+        futures = [ctl.submit(_img(i), _plan()) for i in range(2)]
+        for fut in futures:
+            with pytest.raises(OSError, match="dead readback"):
+                fut.result(timeout=120)
+        assert ctl.metrics.summary()["flyimg_batch_retries_total"] == 2
+    finally:
+        ctl.close()
+
+
+def test_transient_execute_fault_retries():
+    """The batcher.execute hook routes through the same recovery: one
+    transient failure there costs one retry, not the batch."""
+    faults.install(faults.FaultInjector()).plan(
+        "batcher.execute",
+        faults.fail_n_then_succeed(1, lambda: OSError("launch hiccup")),
+    )
+    ctl = _ctl(batch_retries=2)
+    try:
+        fut = ctl.submit(_img(0), _plan())
+        assert fut.result(timeout=120).shape == (16, 16, 3)
+        assert ctl.metrics.summary()["flyimg_batch_retries_total"] == 1
+    finally:
+        ctl.close()
+
+
+def test_transient_hiccup_during_bisection_retries_innocent():
+    """A device hiccup while re-launching an INNOCENT singleton during
+    bisection gets the bounded transient retry, not a permanent 5xx."""
+    injector = faults.install(faults.FaultInjector())
+    injector.plan("batcher.member", _poison_plan())
+    # the poison raises at assembly, so the primary launch never reaches
+    # the drain point — this transient fault fires only on the recovery
+    # sub-launches, hitting an innocent's singleton re-execution
+    injector.plan(
+        "batcher.drain",
+        faults.fail_n_then_succeed(1, lambda: OSError("recovery hiccup")),
+    )
+    ctl = _ctl(max_batch=2, batch_retries=2)
+    try:
+        innocent, poison = _img(0), _img(1, poison=True)
+        f_innocent = ctl.submit(innocent, _plan())
+        f_poison = ctl.submit(poison, _plan())
+        np.testing.assert_array_equal(
+            f_innocent.result(timeout=120), run_plan(innocent, _plan())
+        )
+        with pytest.raises(ValueError, match="poison pixel"):
+            f_poison.result(timeout=120)
+        summary = ctl.metrics.summary()
+        assert summary["flyimg_poison_isolated_total"] == 1
+        assert summary["flyimg_batch_retries_total"] >= 1
+    finally:
+        ctl.close()
+
+
+# ---------------------------------------------------------------------------
+# quarantine
+
+
+def test_quarantine_table_ttl_expiry():
+    clock = [0.0]
+    table = QuarantineTable(10.0, clock=lambda: clock[0])
+    table.add(("key", "digest"))
+    assert table.hit(("key", "digest"))
+    assert not table.hit(("key", "other"))
+    # the submit-path gate: only an implicated plan key pays a digest
+    assert table.has_prefix("key")
+    assert not table.has_prefix("other-key")
+    clock[0] = 9.9
+    assert table.hit(("key", "digest"))
+    clock[0] = 10.0  # TTL elapsed: entry expires (and len() purges)
+    assert not table.hit(("key", "digest"))
+    assert not table.has_prefix("key")
+    assert len(table) == 0
+
+
+def test_quarantine_table_bounded():
+    clock = [0.0]
+    table = QuarantineTable(100.0, max_entries=4, clock=lambda: clock[0])
+    for i in range(10):
+        clock[0] = float(i)
+        table.add(("key", i))
+    assert len(table) <= 4
+    assert table.hit(("key", 9))  # newest survives eviction
+
+
+def test_quarantine_short_circuits_repeat_offender():
+    """After isolation, the same (plan, image) resubmits as a forced
+    singleton: it cannot share a batch, and once the fault clears it
+    serves normally."""
+    faults.install(faults.FaultInjector()).plan(
+        "batcher.member", _poison_plan()
+    )
+    ctl = _ctl(max_batch=4)
+    try:
+        poison = _img(0, poison=True)
+        futures = [ctl.submit(_img(i + 1), _plan()) for i in range(3)]
+        futures.append(ctl.submit(poison, _plan()))
+        with pytest.raises(ValueError):
+            futures[-1].result(timeout=120)
+        for fut in futures[:-1]:
+            assert fut.result(timeout=120).shape == (16, 16, 3)
+        # resubmit while still poisoning: fails ALONE, no innocents near
+        fut = ctl.submit(poison, _plan())
+        with pytest.raises(ValueError):
+            fut.result(timeout=120)
+        assert ctl.metrics.summary()["flyimg_quarantine_hits_total"] == 1
+        # fault cleared: the quarantined singleton executes and serves
+        faults.clear()
+        fut = ctl.submit(poison, _plan())
+        assert fut.result(timeout=120).shape == (16, 16, 3)
+        assert ctl.metrics.summary()["flyimg_quarantine_hits_total"] == 2
+    finally:
+        ctl.close()
+
+
+def test_requeued_poison_refingerprints_under_base_key():
+    """A quarantined singleton that poisons AGAIN must re-enter the table
+    under the base plan key (not its nonce-suffixed group key), so later
+    submissions keep hitting quarantine."""
+    faults.install(faults.FaultInjector()).plan(
+        "batcher.member", _poison_plan()
+    )
+    ctl = _ctl(max_batch=2)
+    try:
+        poison = _img(0, poison=True)
+        with pytest.raises(ValueError):
+            ctl.submit(poison, _plan()).result(timeout=120)
+        for expected_hits in (1, 2):  # every resubmission keeps hitting
+            with pytest.raises(ValueError):
+                ctl.submit(poison, _plan()).result(timeout=120)
+            assert (
+                ctl.metrics.summary()["flyimg_quarantine_hits_total"]
+                == expected_hits
+            )
+        assert len(ctl.quarantine) == 1  # one fingerprint, refreshed
+    finally:
+        ctl.close()
+
+
+# ---------------------------------------------------------------------------
+# executor self-healing
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+def test_executor_restart_after_thread_death():
+    """A BaseException escaping a batch kills the executor thread; the
+    next submission detects the corpse, replaces it, and is served."""
+    faults.install(faults.FaultInjector()).plan(
+        "batcher.execute",
+        lambda **_: (_ for _ in ()).throw(SystemExit("chaos")),
+    )
+    ctl = _ctl(max_batch=2)
+    try:
+        fut = ctl.submit(_img(0), _plan())
+        with pytest.raises(RuntimeError, match="executor died"):
+            fut.result(timeout=60)
+        for _ in range(500):  # let the killed thread actually exit
+            if not ctl._thread.is_alive():
+                break
+            time.sleep(0.01)
+        assert not ctl._thread.is_alive()
+        faults.clear()
+        fut = ctl.submit(_img(1), _plan())
+        assert fut.result(timeout=120).shape == (16, 16, 3)
+        assert ctl.metrics.summary()[
+            'flyimg_executor_restarts_total{reason="dead"}'
+        ] == 1
+    finally:
+        ctl.close()
+
+
+def test_executor_restart_when_wedged_rehomes_queue():
+    """A wedged executor (stuck inside one launch) is replaced once the
+    wedge bound passes; queued groups run on the replacement while the
+    original batch still resolves when the wedge releases."""
+    wedge = threading.Event()
+    faults.install(faults.FaultInjector()).plan(
+        "batcher.execute", faults.wedge_until(wedge)
+    )
+    ctl = _ctl(max_batch=2, lone_flush=True, executor_wedge_timeout_s=0.2)
+    try:
+        first = ctl.submit(_img(0), _plan())  # executor wedges on this
+        time.sleep(0.4)  # exceed the wedge bound
+        faults.clear()  # the replacement must run clean
+        second = ctl.submit(_img(1), _plan())  # detection + restart here
+        assert second.result(timeout=120).shape == (16, 16, 3)
+        wedge.set()  # superseded thread unwedges and finishes its batch
+        assert first.result(timeout=120).shape == (16, 16, 3)
+        assert ctl.metrics.summary()[
+            'flyimg_executor_restarts_total{reason="wedged"}'
+        ] == 1
+    finally:
+        wedge.set()
+        ctl.close()
+
+
+# ---------------------------------------------------------------------------
+# _drain regression: settled futures must not fail their batch-mates
+
+
+def test_drain_skips_already_settled_future():
+    """One cancelled/settled member future mid-batch previously raised
+    InvalidStateError inside the drain loop and diverted every REMAINING
+    member to the failure path; resolution is done()-guarded now."""
+    wedge = threading.Event()
+    faults.install(faults.FaultInjector()).plan(
+        "batcher.execute", faults.wedge_until(wedge)
+    )
+    ctl = _ctl(max_batch=3)
+    try:
+        images = [_img(i) for i in range(3)]
+        futures = [ctl.submit(img, _plan()) for img in images]
+        # the batch is full -> popped -> wedged at the execute hook; a
+        # client walks away mid-flight:
+        assert futures[1].cancel()
+        wedge.set()
+        np.testing.assert_array_equal(
+            futures[0].result(timeout=120), run_plan(images[0], _plan())
+        )
+        np.testing.assert_array_equal(
+            futures[2].result(timeout=120), run_plan(images[2], _plan())
+        )
+    finally:
+        wedge.set()
+        ctl.close()
+
+
+def test_image_digest_stable_and_distinct():
+    a, b = _img(1), _img(2)
+    assert _image_digest(a) == _image_digest(a.copy())
+    assert _image_digest(a) != _image_digest(b)

@@ -150,13 +150,20 @@ def test_refresh_reprocesses(env):
     handler, storage, tmp = env
     src = _write_png(tmp / "f.png")
     first = handler.process_image("w_80,o_png", src)
-    # overwrite the stored artifact to prove rf_1 recomputes it
-    storage.write(first.spec.name, b"corrupted")
+    # plant DIFFERENT-but-valid png bytes under the stored name to prove
+    # rf_1 recomputes (corrupt bytes would be self-healed as a cache miss
+    # by the read-time integrity check even without rf_1 — that behavior
+    # is pinned in tests/test_resilience.py)
+    buf = io.BytesIO()
+    Image.new("RGB", (5, 5), (1, 2, 3)).save(buf, "PNG")
+    planted = buf.getvalue()
+    storage.write(first.spec.name, planted)
     cached = handler.process_image("w_80,o_png", src)
-    assert cached.content == b"corrupted"
+    assert cached.content == planted
     refreshed = handler.process_image("w_80,o_png,rf_1", src)
-    assert refreshed.content != b"corrupted"
+    assert refreshed.content != planted
     assert _fmt(refreshed.content) == "PNG"
+    assert Image.open(io.BytesIO(refreshed.content)).size[0] == 80
 
 
 def test_png_alpha_preserved_without_geometry(env):
@@ -270,7 +277,12 @@ def test_concurrent_misses_coalesce_to_one_pipeline(env, monkeypatch):
         calls.append(1)
         import time as _t
 
-        _t.sleep(0.2)  # hold the leader open so followers pile up
+        # hold the leader open so followers pile up; generous because a
+        # loaded single-core runner can starve a follower thread for
+        # hundreds of ms before it reaches the cache check — a follower
+        # arriving after the leader stored reads as a plain cache hit
+        # and flakes the coalesced-count assertion
+        _t.sleep(0.75)
         return real(data, options, spec, timings, **kwargs)
 
     monkeypatch.setattr(handler, "_process_new", slow_process)

@@ -9,6 +9,12 @@ Acceptance behaviors pinned here (ISSUE 1):
 - a full batcher queue returns 503 with Retry-After,
 - an exhausted deadline returns 504 without waiting out the remaining
   stage timeouts.
+
+ISSUE 3 satellites pinned here: /readyz flips 503 before the shutdown
+drain, and corrupt cached outputs are treated as misses (deleted +
+re-rendered + counted). The device-batch blast-radius layer itself —
+poison bisection, quarantine, executor self-healing — is covered in
+tests/test_batch_isolation.py.
 """
 
 import asyncio
@@ -766,6 +772,73 @@ def test_http_open_breaker_rejects_without_fetch(tmp_path):
     assert "CircuitOpenException" in body
     assert "Retry-After" in headers
     assert elapsed < 1.0
+
+
+# ---------------------------------------------------------------------------
+# Readiness + graceful drain, cache-read integrity (ISSUE 3 satellites)
+
+
+def test_readyz_flips_503_when_draining(tmp_path):
+    """/readyz (readiness) is distinct from /healthz (liveness) and
+    answers 503 the moment shutdown begins — BEFORE the batcher drain in
+    on_cleanup — so load balancers stop routing during the drain."""
+
+    async def scenario(client):
+        ready = await client.get("/readyz")
+        alive = await client.get("/healthz")
+        await client.server.app.shutdown()  # on_shutdown only; still serving
+        draining = await client.get("/readyz")
+        return ready.status, alive.status, draining.status, (
+            await draining.text()
+        )
+
+    ready, alive, draining, body = _serve(tmp_path, scenario)
+    assert ready == 200
+    assert alive == 200
+    assert draining == 503
+    assert "draining" in body
+
+
+def test_corrupt_cache_entry_rerendered(tmp_path, source_png):
+    """A corrupt/truncated stored output is a miss, not a 200 of garbage:
+    the entry is deleted, counted, and the request re-renders."""
+    import os
+
+    async def scenario(client):
+        url = f"/upload/w_20,o_png/{source_png}"
+        first = await client.get(url)
+        good = await first.read()
+        updir = str(tmp_path / "uploads")
+        for name in os.listdir(updir):
+            with open(os.path.join(updir, name), "wb") as fh:
+                fh.write(b"truncated garbage, not a png")
+        second = await client.get(url)
+        regood = await second.read()
+        metrics = await (await client.get("/metrics")).text()
+        return first.status, good, second.status, regood, metrics
+
+    first, good, second, regood, metrics = _serve(tmp_path, scenario)
+    assert first == 200 and second == 200
+    assert regood[:8] == b"\x89PNG\r\n\x1a\n"  # re-rendered, not garbage
+    assert regood == good
+    assert "flyimg_cache_corrupt_total 1" in metrics
+
+
+def test_empty_cache_entry_is_a_miss(tmp_path, source_png):
+    import os
+
+    async def scenario(client):
+        url = f"/upload/w_24,o_png/{source_png}"
+        await client.get(url)
+        updir = str(tmp_path / "uploads")
+        for name in os.listdir(updir):
+            with open(os.path.join(updir, name), "wb") as fh:
+                fh.write(b"")
+        resp = await client.get(url)
+        return resp.status, await resp.read()
+
+    status, body = _serve(tmp_path, scenario)
+    assert status == 200 and body[:8] == b"\x89PNG\r\n\x1a\n"
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,191 @@
+"""CI chaos smoke: boot the app on CPU, fire concurrent requests whose
+shared device batch contains ONE injected poison member, and assert the
+blast radius held — every innocent request answers 200, the poison request
+alone errors, the isolation counters moved, and /readyz drains cleanly on
+shutdown.
+
+    JAX_PLATFORMS=cpu python tools/smoke_chaos.py
+
+Exit code 0 = every assertion held. This is smoke-level (one in-process
+app, one poisoned batch) — the behavioral matrix (bisection cost bounds,
+quarantine TTL, executor self-healing) lives in
+tests/test_batch_isolation.py; this script exists so CI proves the
+wired-together service contains a poison member end to end
+(docs/resilience.md), not just that the batcher unit does.
+
+Choreography: the executor is wedged on a first innocent request
+(``batcher.execute`` gate), the remaining requests — innocents plus the
+poison — queue into one group while it holds, then the gate opens and the
+group executes as a single poisoned batch that the batcher must bisect.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+import tempfile
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+N_REQUESTS = 8  # 1 gate-holder + 6 innocents + 1 poison
+POISON_INDEX = 3
+
+
+def _require(cond: bool, what: str) -> None:
+    if not cond:
+        print(f"FAIL: {what}", file=sys.stderr)
+        raise SystemExit(1)
+
+
+def _metric_value(text: str, name: str) -> float:
+    for line in text.splitlines():
+        if line.startswith(name) and not line.startswith("#"):
+            try:
+                return float(line.rsplit(" ", 1)[1])
+            except ValueError:
+                continue
+    return 0.0
+
+
+async def main() -> int:
+    import numpy as np
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from flyimg_tpu.appconfig import AppParameters
+    from flyimg_tpu.codecs import encode
+    from flyimg_tpu.service.app import make_app
+    from flyimg_tpu.testing import faults
+
+    # enough worker threads for every request to reach the batcher at
+    # once (the default executor is cpu-count-sized on small CI runners)
+    asyncio.get_running_loop().set_default_executor(
+        ThreadPoolExecutor(max_workers=N_REQUESTS + 4)
+    )
+
+    tmp = tempfile.mkdtemp(prefix="flyimg-chaos-")
+    rng = np.random.default_rng(0)
+    marker = np.array([255, 0, 255], dtype=np.uint8)
+    sources = []
+    for i in range(N_REQUESTS):
+        img = rng.integers(0, 200, (48, 64, 3), dtype=np.uint8)
+        img[0, 0] = marker if i == POISON_INDEX else (0, 0, 0)
+        path = os.path.join(tmp, f"src-{i}.png")
+        with open(path, "wb") as fh:
+            fh.write(encode(img, "png"))
+        sources.append(path)
+
+    gate = threading.Event()
+    injector = faults.FaultInjector()
+    injector.plan("batcher.execute", faults.wedge_until(gate))
+    injector.plan(
+        "batcher.member",
+        faults.poison_member(
+            lambda image=None, **_: (
+                getattr(image, "ndim", 0) == 3
+                and bool(np.all(image[0, 0] == marker))
+            ),
+            lambda: ValueError("chaos poison member"),
+        ),
+    )
+    params = AppParameters(
+        {
+            "tmp_dir": os.path.join(tmp, "t"),
+            "upload_dir": os.path.join(tmp, "u"),
+            "batch_deadline_ms": 50.0,
+            "fault_injector": injector,
+        }
+    )
+    app = make_app(params)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        ready = await client.get("/readyz")
+        _require(ready.status == 200, f"/readyz before drain {ready.status}")
+
+        # 1) the gate-holder: wedges the executor so the rest can queue
+        first = asyncio.ensure_future(
+            client.get(f"/upload/w_32,o_png/{sources[0]}")
+        )
+        for _ in range(200):
+            await asyncio.sleep(0.02)
+            if injector.fired.get("batcher.execute"):
+                break
+        _require(
+            injector.fired.get("batcher.execute", 0) >= 1,
+            "executor wedged on the first request",
+        )
+
+        # 2) innocents + poison pile into one queued group
+        rest = [
+            asyncio.ensure_future(
+                client.get(f"/upload/w_32,o_png/{src}")
+            )
+            for src in sources[1:]
+        ]
+        for _ in range(300):
+            await asyncio.sleep(0.02)
+            metrics = await (await client.get("/metrics")).text()
+            depth = _metric_value(
+                metrics, 'flyimg_batcher_queue_depth{controller="device"}'
+            )
+            if depth >= N_REQUESTS:
+                break
+        _require(
+            depth >= N_REQUESTS,
+            f"all {N_REQUESTS} submissions pending (saw {depth})",
+        )
+
+        # 3) open the gate: the poisoned batch executes and must bisect
+        gate.set()
+        responses = [await first] + [await fut for fut in rest]
+        for i, resp in enumerate(responses):
+            if i == POISON_INDEX:
+                _require(
+                    resp.status >= 500,
+                    f"poison request errored (got {resp.status})",
+                )
+            else:
+                _require(
+                    resp.status == 200,
+                    f"innocent request {i} served (got {resp.status})",
+                )
+                body = await resp.read()
+                _require(
+                    body[:8] == b"\x89PNG\r\n\x1a\n",
+                    f"innocent request {i} returned png bytes",
+                )
+
+        metrics = await (await client.get("/metrics")).text()
+        isolated = _metric_value(metrics, "flyimg_poison_isolated_total")
+        _require(
+            isolated == 1, f"exactly one poison isolated (saw {isolated})"
+        )
+
+        # 4) graceful drain: readiness flips before cleanup runs
+        await app.shutdown()
+        draining = await client.get("/readyz")
+        _require(
+            draining.status == 503,
+            f"/readyz while draining {draining.status}",
+        )
+        alive = await client.get("/healthz")
+        _require(
+            alive.status == 200,
+            f"/healthz stays live during drain {alive.status}",
+        )
+        print(
+            f"chaos smoke OK: {N_REQUESTS - 1} innocents 200, poison "
+            f"isolated alone, /readyz drained"
+        )
+        return 0
+    finally:
+        gate.set()
+        await client.close()
+
+
+if __name__ == "__main__":
+    raise SystemExit(asyncio.run(main()))
