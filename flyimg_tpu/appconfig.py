@@ -110,6 +110,34 @@ SERVER_DEFAULTS: Dict[str, Any] = {
     "log_format": "json",
     "log_level": "info",
     "log_access": True,
+    # --- SLOs + perf observability (runtime/slo.py, runtime/metrics.py;
+    # docs/observability.md "SLOs and burn rates") ---
+    # declarative objectives evaluated over sliding windows; breaches
+    # (fast AND slow burn over threshold) log + span-event + counter
+    "slo_enabled": True,
+    # latency objective: requests slower than this are "slow" against the
+    # (1 - slo_latency_quantile) latency budget — the BASELINE target
+    "slo_latency_p99_ms": 150.0,
+    # availability objective in percent; 99.9 -> 0.1% error budget
+    "slo_availability": 99.9,
+    "slo_latency_quantile": 0.99,
+    # multi-window burn-rate evaluation: fast window catches pages-now
+    # incidents, slow window suppresses blips (SRE-workbook thresholds)
+    "slo_window_fast_s": 300.0,
+    "slo_window_slow_s": 3600.0,
+    "slo_burn_threshold_fast": 14.4,
+    "slo_burn_threshold_slow": 6.0,
+    # OpenMetrics exemplars on latency-histogram buckets: each bucket
+    # remembers the last traced observation's trace id, linking /metrics
+    # tails straight to /debug/traces/{id}
+    "metrics_exemplars": True,
+    # --- perf-regression gate defaults (tools/perf_gate.py; CLI flags
+    # override; benchmarks/README.md "baseline refresh policy") ---
+    # a stage regresses when its calibrated median exceeds
+    # baseline * tolerance (CI passes a wider, noise-tolerant band)
+    "perf_gate_tolerance": 1.6,
+    "perf_gate_repeats": 30,
+    "perf_gate_warmup": 3,
 }
 
 

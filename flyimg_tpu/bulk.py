@@ -16,7 +16,8 @@ Usage:
         --options w_256,h_256,c_1 [--format jpg] [--workers 8]
 
 Prints one JSON line: {images, failed, images_per_sec, batches,
-mean_occupancy}. Library surface: ``bulk_process()``.
+mean_occupancy, padding_waste, queue_wait_share}. Library surface:
+``bulk_process()``.
 """
 
 from __future__ import annotations
@@ -187,6 +188,10 @@ def bulk_process(
         "images_per_sec": round(done / elapsed, 1) if elapsed > 0 else 0.0,
         "batches": stats["batches"],
         "mean_occupancy": round(stats["mean_occupancy"], 2),
+        # the same efficiency vocabulary the HTTP path serves at
+        # /debug/perf (rolling window over this sweep's launches)
+        "padding_waste": round(stats["padding_waste"], 2),
+        "queue_wait_share": round(stats["queue_wait_share"], 2),
     }
 
 

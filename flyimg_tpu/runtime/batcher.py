@@ -548,11 +548,32 @@ class BatchController:
         summary = self.metrics.summary()
         images = summary.get("flyimg_images_processed_total", 0.0)
         slots = summary.get("flyimg_batch_slots_total", 0.0)
+        # rolling per-controller efficiency (runtime/metrics.py
+        # BatchEfficiency): the same vocabulary /debug/perf serves, so
+        # bulk sweeps and the HTTP path report identical fields. The
+        # occupancy/waste pair comes from the SAME window (occupancy from
+        # the since-boot counters next to a rolling waste would read
+        # mutually inconsistent on long sweeps); the counter-derived
+        # ratio stays available as `cumulative_occupancy`.
+        eff = self.metrics.batch_efficiency(self.name).stats()
         return {
             "batches": summary.get("flyimg_batches_total", 0.0),
             "images": images,
-            "mean_occupancy": images / slots if slots else 0.0,
+            "mean_occupancy": eff["mean_occupancy"],
+            "cumulative_occupancy": images / slots if slots else 0.0,
+            "padding_waste": eff["padding_waste"],
+            "queue_wait_share": eff["queue_wait_share"],
+            "batches_per_compile_miss": eff["batches_per_compile_miss"],
         }
+
+    @staticmethod
+    def _member_trace_id(members: List[_Pending]) -> Optional[str]:
+        """First traced member's trace id — the exemplar the latency
+        histograms attach so a bucket links to a retrievable trace."""
+        for member in members:
+            if member.trace is not None:
+                return member.trace.trace_id
+        return None
 
     def close(self, drain_timeout_s: float = 30.0) -> None:
         with self._lock:
@@ -784,6 +805,12 @@ class BatchController:
         except Exception as exc:
             self._recover(group, members, exc)
             return
+        # queue wait of the oldest member at launch time — the
+        # batch-efficiency record's "how long did batching cost" half
+        # (the other half is device_s, measured at readback)
+        queue_wait_s = time.monotonic() - min(
+            m.enqueued_at for m in members
+        )
         if group.runner is not None:
             # the wedge clock keeps running across the aux runner call
             # (deliberate: aux batches are sub-second host codec work, so
@@ -797,7 +824,9 @@ class BatchController:
                     "batch.runner", getattr(group.runner, "__name__", "aux")
                 )
             try:
+                t_aux = time.perf_counter()
                 outputs = group.runner([m.image for m in members])
+                aux_s = time.perf_counter() - t_aux
                 if len(outputs) != n:
                     raise RuntimeError(
                         f"aux runner returned {len(outputs)} results for "
@@ -814,6 +843,14 @@ class BatchController:
                     "flyimg_aux_items_total",
                     "Items through batched auxiliary programs",
                 ).inc(n)
+                # efficiency window only (aux=True skips the transform
+                # counters): aux launches have no padding or compile step
+                self.metrics.record_batch_launch(
+                    self.name, images=n, capacity=n,
+                    queue_wait_s=queue_wait_s, device_s=aux_s,
+                    compile_hit=None,
+                    trace_id=self._member_trace_id(members), aux=True,
+                )
                 if span_obj is not None:
                     span_obj.end()
                     self._attach_batch_span(members, span_obj)
@@ -871,7 +908,7 @@ class BatchController:
                     target=self._drain,
                     args=(
                         group, members, dev_out, n, batch, t_dispatch,
-                        span_obj, inflight,
+                        span_obj, inflight, queue_wait_s, compile_hit,
                     ),
                     name="flyimg-batcher-drain",
                     daemon=True,
@@ -996,7 +1033,9 @@ class BatchController:
 
     def _drain(self, group: _Group, members, dev_out, n: int, batch: int,
                t_dispatch: Optional[float] = None, span_obj=None,
-               inflight: Optional[threading.Semaphore] = None) -> None:
+               inflight: Optional[threading.Semaphore] = None,
+               queue_wait_s: float = 0.0,
+               compile_hit: Optional[bool] = None) -> None:
         """Blocking device->host read + future resolution for one
         dispatched batch (runs on a daemon drain thread). ``inflight`` is
         the pipeline semaphore instance this batch acquired from (the
@@ -1004,14 +1043,18 @@ class BatchController:
         try:
             faults.fire("batcher.drain", key=group.key, n=n, batch=batch)
             out = np.asarray(dev_out)
+            trace_id = self._member_trace_id(members)
             device_s = (
                 time.perf_counter() - t_dispatch
                 if t_dispatch is not None else None
             )
             if device_s is not None:
                 # dispatch -> completed readback: what the batch actually
-                # held the device (and its members) for
-                self.metrics.record_device_batch_seconds(device_s)
+                # held the device (and its members) for; the exemplar
+                # links this bucket to one member's retrievable trace
+                self.metrics.record_device_batch_seconds(
+                    device_s, trace_id=trace_id
+                )
             if span_obj is not None:
                 span_obj.end()
                 if device_s is not None:
@@ -1019,7 +1062,11 @@ class BatchController:
                         "device.seconds", round(device_s, 6)
                     )
                 self._attach_batch_span(members, span_obj)
-            self.metrics.record_batch(n, batch)
+            self.metrics.record_batch_launch(
+                self.name, images=n, capacity=batch,
+                queue_wait_s=queue_wait_s, device_s=device_s,
+                compile_hit=compile_hit, trace_id=trace_id,
+            )
             self._resolve_members(group, members, out)
         except Exception as exc:
             if span_obj is not None and span_obj.duration_s is None:
@@ -1187,6 +1234,9 @@ class BatchController:
             seq = self._batch_seq
         self._touch_busy()  # each recovery launch is wedge-clock progress
         n = len(members)
+        queue_wait_s = time.monotonic() - min(
+            m.enqueued_at for m in members
+        )
         if group.runner is not None:
             for i, member in enumerate(members):
                 faults.fire(
@@ -1195,22 +1245,35 @@ class BatchController:
                     index=i,
                     image=member.image,
                 )
+            t_aux = time.perf_counter()
             outputs = group.runner([m.image for m in members])
+            aux_s = time.perf_counter() - t_aux
             if len(outputs) != n:
                 raise RuntimeError(
                     f"aux runner returned {len(outputs)} results for "
                     f"{n} payloads"
                 )
             faults.fire("batcher.drain", key=group.key, n=n, batch=n)
+            self.metrics.record_batch_launch(
+                self.name, images=n, capacity=n, queue_wait_s=queue_wait_s,
+                device_s=aux_s, compile_hit=None,
+                trace_id=self._member_trace_id(members), aux=True,
+            )
             return outputs
         batch, arrays = self._assemble(group, members)
         fn, compile_hit = self._program(group, batch)
         if not compile_hit:
             self._suspend_busy()  # synchronous XLA compile ahead
+        t_dispatch = time.perf_counter()
         with jax.profiler.TraceAnnotation(f"flyimg:batch:{seq}"):
             dev_out = fn(*(jnp.asarray(a) for a in arrays))
         self._touch_busy()  # dispatch returned: progress
         faults.fire("batcher.drain", key=group.key, n=n, batch=batch)
         out = np.asarray(dev_out)
-        self.metrics.record_batch(n, batch)
+        self.metrics.record_batch_launch(
+            self.name, images=n, capacity=batch, queue_wait_s=queue_wait_s,
+            device_s=time.perf_counter() - t_dispatch,
+            compile_hit=compile_hit,
+            trace_id=self._member_trace_id(members),
+        )
         return out

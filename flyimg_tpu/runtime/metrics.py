@@ -18,8 +18,10 @@ Design notes:
 
 from __future__ import annotations
 
+import re
 import threading
 import time
+from collections import deque
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 # log-spaced latency buckets in seconds: 23 buckets, x1.8 apart,
@@ -30,6 +32,65 @@ _N_BUCKETS = 23
 BUCKET_BOUNDS: Tuple[float, ...] = tuple(
     _BUCKET_BASE * _BUCKET_FACTOR ** i for i in range(_N_BUCKETS)
 )
+
+# batch-efficiency histogram bounds (docs/observability.md "Batch
+# efficiency"): occupancy is a ratio in (0, 1], bucket sizes ride the
+# power-of-two ladder — latency bounds would be meaningless for either
+OCCUPANCY_BOUNDS: Tuple[float, ...] = (
+    0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0
+)
+BATCH_SIZE_BOUNDS: Tuple[float, ...] = (1, 2, 4, 8, 16, 32, 64)
+
+# cached module ref for exemplar trace-id lookup (lazy: metrics must stay
+# importable/fast without dragging the tracing module in at import time)
+_tracing_mod = None
+
+
+def _ambient_trace_id() -> Optional[str]:
+    """Trace id of the ambient request trace, for OpenMetrics exemplars.
+    No active trace (or tracing not yet imported by anything) -> None in
+    a few instructions — this sits on the record_stage hot path."""
+    global _tracing_mod
+    if _tracing_mod is None:
+        from flyimg_tpu.runtime import tracing as _t
+
+        _tracing_mod = _t
+    trace = _tracing_mod.current_trace()
+    return trace.trace_id if trace is not None else None
+
+
+def bucket_index(value: float, bounds: Tuple[float, ...]) -> int:
+    """Index of the bucket ``value`` lands in (len(bounds) = overflow).
+    THE bucketing rule — Histogram.observe and the SLO engine's window
+    slices must agree or their quantiles drift apart."""
+    for i, bound in enumerate(bounds):
+        if value <= bound:
+            return i
+    return len(bounds)
+
+
+def quantile_from_counts(counts: List[int], bounds: Tuple[float, ...],
+                         q: float) -> float:
+    """In-bucket linearly interpolated q-quantile over bucket counts (the
+    histogram_quantile() rule). ONE copy shared by Histogram.quantile and
+    the SLO engine's windowed p99 — the PR-2 interpolation fix showed why
+    this math must not fork. Overflow-bucket quantiles are +inf (no upper
+    bound to interpolate toward); empty counts -> 0."""
+    n = sum(counts)
+    if n == 0:
+        return 0.0
+    target = q * n
+    acc = 0
+    for i, c in enumerate(counts):
+        prev = acc
+        acc += c
+        if acc >= target and c > 0:
+            if i >= len(bounds):
+                return float("inf")
+            lo = bounds[i - 1] if i > 0 else 0.0
+            hi = bounds[i]
+            return lo + (hi - lo) * ((target - prev) / c)
+    return float("inf")
 
 
 def escape_label_value(value: str) -> str:
@@ -104,26 +165,35 @@ class Gauge:
 
 
 class Histogram:
-    """Fixed-bucket latency histogram with quantile estimation."""
+    """Fixed-bucket histogram with quantile estimation and optional
+    OpenMetrics exemplars. Default bounds are the log-spaced latency
+    ladder; ``bounds`` overrides them for non-latency distributions
+    (occupancy ratios, batch-size buckets)."""
 
-    def __init__(self, name: str, help_text: str = "") -> None:
+    def __init__(self, name: str, help_text: str = "",
+                 bounds: Optional[Tuple[float, ...]] = None) -> None:
         self.name = name
         self.help = help_text
-        self._counts = [0] * (_N_BUCKETS + 1)  # +1 overflow bucket
+        self.bounds: Tuple[float, ...] = (
+            BUCKET_BOUNDS if bounds is None else tuple(bounds)
+        )
+        self._counts = [0] * (len(self.bounds) + 1)  # +1 overflow bucket
         self._sum = 0.0
         self._n = 0
+        # per-bucket exemplar: (observed value, trace_id, unix ts) — the
+        # OpenMetrics hook that links a latency bucket to one concrete
+        # trace in the ring (last observation wins, the standard policy)
+        self._exemplars: Dict[int, Tuple[float, str, float]] = {}
         self._lock = threading.Lock()
 
-    def observe(self, seconds: float) -> None:
-        idx = _N_BUCKETS
-        for i, bound in enumerate(BUCKET_BOUNDS):
-            if seconds <= bound:
-                idx = i
-                break
+    def observe(self, seconds: float, trace_id: Optional[str] = None) -> None:
+        idx = bucket_index(seconds, self.bounds)
         with self._lock:
             self._counts[idx] += 1
             self._sum += seconds
             self._n += 1
+            if trace_id:
+                self._exemplars[idx] = (seconds, trace_id, time.time())
 
     def quantile(self, q: float) -> float:
         """Estimate of the q-quantile (0 < q <= 1), interpolated linearly
@@ -133,37 +203,103 @@ class Histogram:
         lower edge. Overflow-bucket quantiles stay +inf — there is no
         upper bound to interpolate toward."""
         with self._lock:
-            n = self._n
             counts = list(self._counts)
-        if n == 0:
-            return 0.0
-        target = q * n
-        acc = 0
-        for i, c in enumerate(counts):
-            prev = acc
-            acc += c
-            if acc >= target and c > 0:
-                if i >= _N_BUCKETS:
-                    return float("inf")
-                lo = BUCKET_BOUNDS[i - 1] if i > 0 else 0.0
-                hi = BUCKET_BOUNDS[i]
-                return lo + (hi - lo) * ((target - prev) / c)
-        return float("inf")
+        return quantile_from_counts(counts, self.bounds, q)
 
     def snapshot(self) -> Tuple[List[int], float, int]:
         with self._lock:
             return list(self._counts), self._sum, self._n
 
+    def exemplars(self) -> Dict[int, Tuple[float, str, float]]:
+        with self._lock:
+            return dict(self._exemplars)
+
+
+class BatchEfficiency:
+    """Rolling batch-efficiency window for ONE controller: the last
+    ``window`` launches' occupancy, padded-slot waste, queue-wait vs
+    device-time share, and compile amortization. Counters answer
+    "since boot"; operators tuning ``batch_deadline_ms``/``batch_max_size``
+    need "lately" — this is the object behind ``/debug/perf`` and the
+    batcher's ``stats()``."""
+
+    def __init__(self, window: int = 256) -> None:
+        self._lock = threading.Lock()
+        # (images, capacity, queue_wait_s, device_s, compile_hit|None)
+        self._entries: deque = deque(maxlen=max(1, int(window)))
+
+    def record(self, *, images: int, capacity: int, queue_wait_s: float,
+               device_s: Optional[float],
+               compile_hit: Optional[bool]) -> None:
+        with self._lock:
+            self._entries.append((
+                int(images), int(capacity), max(float(queue_wait_s), 0.0),
+                float(device_s) if device_s is not None else 0.0,
+                compile_hit,
+            ))
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            entries = list(self._entries)
+        if not entries:
+            return {
+                "window_batches": 0, "mean_occupancy": 0.0,
+                "padding_waste": 0.0, "queue_wait_share": 0.0,
+                "batches_per_compile_miss": 0.0,
+                "mean_queue_wait_ms": 0.0, "mean_device_ms": 0.0,
+            }
+        images = sum(e[0] for e in entries)
+        slots = sum(e[1] for e in entries)
+        queue_wait = sum(e[2] for e in entries)
+        device = sum(e[3] for e in entries)
+        # compile amortization counts only launches where a compile COULD
+        # have happened (compile_hit is None for aux/host-codec launches);
+        # zero misses in the window reports the window length — a floor,
+        # not an exact amortization (documented in docs/observability.md)
+        compiled = [e[4] for e in entries if e[4] is not None]
+        misses = sum(1 for hit in compiled if not hit)
+        occupancy = images / slots if slots else 0.0
+        return {
+            "window_batches": len(entries),
+            "mean_occupancy": occupancy,
+            "padding_waste": 1.0 - occupancy if slots else 0.0,
+            "queue_wait_share": (
+                queue_wait / (queue_wait + device)
+                if (queue_wait + device) > 0 else 0.0
+            ),
+            "batches_per_compile_miss": (
+                len(compiled) / misses if misses
+                else float(len(compiled))
+            ),
+            "mean_queue_wait_ms": queue_wait / len(entries) * 1000.0,
+            "mean_device_ms": device / len(entries) * 1000.0,
+        }
+
 
 class MetricsRegistry:
     """Named metric store; one per app."""
 
-    def __init__(self) -> None:
+    def __init__(self, *, exemplars: bool = True) -> None:
         self._lock = threading.Lock()
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
         self._histograms: Dict[str, Histogram] = {}
+        # OpenMetrics exemplars on latency-histogram buckets (the
+        # `metrics_exemplars` appconfig knob): each bucket remembers the
+        # last traced observation that landed in it, so an SLO breach
+        # links straight from /metrics to /debug/traces/{id}
+        self.exemplars_enabled = bool(exemplars)
+        # rolling per-controller batch-efficiency windows (runtime/batcher)
+        self._batch_eff: Dict[str, BatchEfficiency] = {}
+        # SLO engine attached by the app (runtime/slo.py) so summary()
+        # speaks the same vocabulary as /debug/slo
+        self._slo = None
         self.started_at = time.time()
+
+    def _exemplar_trace_id(self) -> Optional[str]:
+        if not self.exemplars_enabled:
+            return None
+        return _ambient_trace_id()
 
     def counter(self, name: str, help_text: str = "") -> Counter:
         with self._lock:
@@ -187,13 +323,29 @@ class MetricsRegistry:
                 metric._fn = fn
             return metric
 
-    def histogram(self, name: str, help_text: str = "") -> Histogram:
+    def histogram(self, name: str, help_text: str = "",
+                  bounds: Optional[Tuple[float, ...]] = None) -> Histogram:
         with self._lock:
             metric = self._histograms.get(name)
             if metric is None:
-                metric = Histogram(name, help_text)
+                metric = Histogram(name, help_text, bounds=bounds)
                 self._histograms[name] = metric
             return metric
+
+    def batch_efficiency(self, controller: str) -> BatchEfficiency:
+        """Get-or-create the rolling efficiency window for one batch
+        controller (keyed by its name: 'device', 'codec', ...)."""
+        with self._lock:
+            eff = self._batch_eff.get(controller)
+            if eff is None:
+                eff = BatchEfficiency()
+                self._batch_eff[controller] = eff
+            return eff
+
+    def attach_slo(self, engine) -> None:
+        """Attach the app's SLO engine so summary() carries its burn
+        rates/budget alongside the batch-efficiency fields."""
+        self._slo = engine
 
     # -- recording helpers used by the serving path ------------------------
 
@@ -211,15 +363,22 @@ class MetricsRegistry:
         self.histogram(
             f'flyimg_stage_seconds{{stage="{escape_label_value(stage)}"}}',
             "Per-stage pipeline latency",
-        ).observe(seconds)
+        ).observe(seconds, trace_id=self._exemplar_trace_id())
 
-    def record_device_batch_seconds(self, seconds: float) -> None:
+    def record_device_batch_seconds(
+        self, seconds: float, trace_id: Optional[str] = None
+    ) -> None:
         """Wall time of one device batch from dispatch to completed
-        device->host readback (runtime/batcher.py profiling hook)."""
+        device->host readback (runtime/batcher.py profiling hook).
+        ``trace_id`` is a member request's trace for the bucket exemplar —
+        drain threads have no ambient trace, so the batcher passes one."""
         self.histogram(
             "flyimg_device_seconds",
             "Per-batch device time, dispatch to completed readback",
-        ).observe(seconds)
+        ).observe(
+            seconds,
+            trace_id=trace_id if self.exemplars_enabled else None,
+        )
 
     def record_compile_event(self, cache_hit: bool) -> None:
         """Batched-program compile cache outcome per device batch."""
@@ -310,13 +469,64 @@ class MetricsRegistry:
             "flyimg_batch_slots_total", "Padded batch slots (occupancy denom)"
         ).inc(capacity)
 
+    def record_batch_launch(
+        self,
+        controller: str,
+        *,
+        images: int,
+        capacity: int,
+        queue_wait_s: float,
+        device_s: Optional[float] = None,
+        compile_hit: Optional[bool] = None,
+        trace_id: Optional[str] = None,
+        aux: bool = False,
+    ) -> None:
+        """THE per-launch efficiency record (runtime/batcher.py, primary
+        and recovery launches alike): feeds the global batch counters
+        (transform launches only — aux items are counted by their own
+        family), the per-controller occupancy/bucket/queue-wait
+        histograms, and the rolling efficiency window behind
+        ``/debug/perf``. ``compile_hit`` is None for launches with no
+        compile step (aux runners)."""
+        if not aux:
+            self.record_batch(images, capacity)
+        safe = escape_label_value(controller)
+        self.histogram(
+            f'flyimg_batch_occupancy_ratio{{controller="{safe}"}}',
+            "Per-launch batch occupancy (images / padded slots)",
+            bounds=OCCUPANCY_BOUNDS,
+        ).observe(images / capacity if capacity else 0.0)
+        self.histogram(
+            f'flyimg_batch_bucket_size{{controller="{safe}"}}',
+            "Padded batch-bucket sizes actually launched",
+            bounds=BATCH_SIZE_BOUNDS,
+        ).observe(float(capacity))
+        self.histogram(
+            f'flyimg_batch_queue_wait_seconds{{controller="{safe}"}}',
+            "Oldest-member queue wait at launch time",
+        ).observe(
+            max(float(queue_wait_s), 0.0),
+            trace_id=trace_id if self.exemplars_enabled else None,
+        )
+        self.batch_efficiency(controller).record(
+            images=images, capacity=capacity, queue_wait_s=queue_wait_s,
+            device_s=device_s, compile_hit=compile_hit,
+        )
+
     # -- rendering ---------------------------------------------------------
 
-    def render_prometheus(self) -> str:
+    def render_prometheus(self, openmetrics: bool = False) -> str:
         """Prometheus text exposition. Metric objects are stored per
         label-set, so rendering groups them into families (one HELP/TYPE
         block per bare metric name, all samples contiguous) as the
-        exposition format requires."""
+        exposition format requires.
+
+        ``openmetrics=True`` (the Accept-negotiated scrape) additionally
+        emits bucket exemplars and the ``# EOF`` terminator. The default
+        text/plain rendering stays pure 0.0.4: the classic format has NO
+        exemplar syntax, and a stock Prometheus text parser aborts the
+        whole scrape on a trailing ``# {...}`` token — exemplars must
+        only reach clients that negotiated for them (service/app.py)."""
         lines: List[str] = []
         with self._lock:
             counters = list(self._counters.values())
@@ -347,16 +557,31 @@ class MetricsRegistry:
                 lines.append(f"# TYPE {bare} histogram")
             for h in family:
                 counts, total, n = h.snapshot()
+                exemplars = (
+                    h.exemplars()
+                    if openmetrics and self.exemplars_enabled else {}
+                )
                 acc = 0
                 for i, count in enumerate(counts):
                     acc += count
                     le = (
-                        f"{BUCKET_BOUNDS[i]:.6f}" if i < _N_BUCKETS else "+Inf"
+                        f"{h.bounds[i]:.6f}" if i < len(h.bounds) else "+Inf"
                     )
-                    lines.append(
+                    line = (
                         f'{_with_label(h.name, "le", le, suffix="_bucket")} '
                         f"{acc}"
                     )
+                    ex = exemplars.get(i)
+                    if ex is not None:
+                        # OpenMetrics exemplar: ` # {labels} value ts` —
+                        # bucket lines ONLY (the conformance test pins
+                        # this); links the bucket to one kept trace
+                        value, trace_id, ts = ex
+                        line += (
+                            f' # {{trace_id="{escape_label_value(trace_id)}"'
+                            f"}} {_fmt(value)} {ts:.3f}"
+                        )
+                    lines.append(line)
                 lines.append(f"{_suffixed(h.name, '_sum')} {_fmt(total)}")
                 lines.append(f"{_suffixed(h.name, '_count')} {n}")
         lines.append("# HELP flyimg_uptime_seconds Process uptime")
@@ -364,14 +589,21 @@ class MetricsRegistry:
         lines.append(
             f"flyimg_uptime_seconds {_fmt(time.time() - self.started_at)}"
         )
+        if openmetrics:
+            lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
     def summary(self) -> Dict[str, float]:
-        """Human/JSON view: key counters + p50/p99 per stage."""
+        """Human/JSON view: key counters + p50/p99 per stage, plus the
+        rolling batch-efficiency windows and (when an SLO engine is
+        attached) the burn rates and budget — one vocabulary shared by
+        bulk sweeps, /debug/perf, and /debug/slo."""
         out: Dict[str, float] = {}
         with self._lock:
             counters = dict(self._counters)
             histograms = dict(self._histograms)
+            batch_eff = dict(self._batch_eff)
+            slo = self._slo
         for name, c in counters.items():
             out[name] = c.value
         for name, h in histograms.items():
@@ -379,10 +611,69 @@ class MetricsRegistry:
             out[f"{name}:p99"] = h.quantile(0.99)
         slots = out.get("flyimg_batch_slots_total", 0.0)
         if slots:
-            out["flyimg_batch_occupancy"] = (
+            occupancy = (
                 out.get("flyimg_images_processed_total", 0.0) / slots
             )
+            out["flyimg_batch_occupancy"] = occupancy
+            out["flyimg_batch_padding_waste"] = 1.0 - occupancy
+        for name, eff in batch_eff.items():
+            stats = eff.stats()
+            for key in (
+                "mean_occupancy", "padding_waste", "queue_wait_share",
+                "batches_per_compile_miss",
+            ):
+                out[f"batch_efficiency:{name}:{key}"] = stats[key]
+        if slo is not None and getattr(slo, "enabled", False):
+            for key, value in slo.summary_fields().items():
+                out[f"slo:{key}"] = value
         return out
+
+    def perf_snapshot(self) -> Dict[str, object]:
+        """The /debug/perf JSON document: per-controller rolling batch
+        efficiency plus per-stage and device-time quantiles — the answers
+        "Beyond Inference" says dominate vision-serving latency (queueing,
+        padding, host codec), in one operator-readable place."""
+        with self._lock:
+            histograms = dict(self._histograms)
+            batch_eff = dict(self._batch_eff)
+
+        def _ms(seconds: float) -> Optional[float]:
+            if seconds != seconds or seconds == float("inf"):
+                return None  # overflow-bucket quantile: no upper bound
+            return round(seconds * 1000.0, 3)
+
+        stages: Dict[str, Dict[str, object]] = {}
+        for name, h in histograms.items():
+            match = re.match(r'flyimg_stage_seconds\{stage="([^"]*)"\}', name)
+            if match is None:
+                continue
+            _, _, n = h.snapshot()
+            stages[match.group(1)] = {
+                "count": n,
+                "p50_ms": _ms(h.quantile(0.5)),
+                "p99_ms": _ms(h.quantile(0.99)),
+            }
+        device = histograms.get("flyimg_device_seconds")
+        device_doc = None
+        if device is not None:
+            _, _, n = device.snapshot()
+            device_doc = {
+                "batches": n,
+                "p50_ms": _ms(device.quantile(0.5)),
+                "p99_ms": _ms(device.quantile(0.99)),
+            }
+        controllers = {}
+        for name, eff in batch_eff.items():
+            stats = eff.stats()
+            controllers[name] = {
+                key: (round(value, 4) if isinstance(value, float) else value)
+                for key, value in stats.items()
+            }
+        return {
+            "controllers": controllers,
+            "stages": stages,
+            "device": device_doc,
+        }
 
 
 def _families(metrics: Iterable) -> List[List]:

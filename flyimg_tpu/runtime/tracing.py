@@ -50,6 +50,7 @@ __all__ = [
     "add_event",
     "parse_traceparent",
     "format_traceparent",
+    "server_timing",
 ]
 
 # hard ceiling on spans held per trace: a pathological request (hundreds of
@@ -161,6 +162,13 @@ class Trace:
         # (one request thread at a time drives the pipeline); the trace
         # itself only stores completed structure
         self.deadline_hit = False
+        # force_keep overrides the tail sampler's probability roll: set
+        # by the SLO engine on the trace that tipped a breach, which may
+        # be neither an error nor "slow" by the tracing threshold (e.g.
+        # 200 ms against a 150 ms objective but a 500 ms slow bar) — the
+        # breach log's trace id must stay retrievable regardless of
+        # sample_rate
+        self.force_keep = False
         self.finished = False
 
     # -- span management ---------------------------------------------------
@@ -327,6 +335,46 @@ def add_event(name: str, **attrs) -> None:
 
 
 # ---------------------------------------------------------------------------
+# Server-Timing: the span tree flattened into one response header
+
+# header metric names are RFC 8941 tokens: letters/digits/_- only
+_ST_NAME_RE = re.compile(r"[^a-zA-Z0-9_-]+")
+
+
+def server_timing(trace: Trace, max_entries: int = 12) -> str:
+    """Flatten one finished trace into a ``Server-Timing`` header value:
+    per-stage durations (fetch/decode/batch_wait/device/encode/...) in
+    first-seen order, same-name spans summed (the two storage spans), the
+    root appended as ``total``. Operators get the stage split from a bare
+    ``curl -sD-`` without opening the trace ring — gated on the ``debug``
+    server param by the HTTP layer (service/app.py), never on by default:
+    stage timings are an internal detail, not a public response contract.
+    """
+    durations: Dict[str, float] = {}
+    order: List[str] = []
+    with trace._lock:
+        spans = list(trace.spans)
+    for span_obj in spans[1:]:  # [0] is the root, reported as `total`
+        if span_obj.duration_s is None:
+            continue
+        name = (
+            "device" if span_obj.name == "device_execute" else span_obj.name
+        )
+        name = _ST_NAME_RE.sub("_", name)
+        if name not in durations:
+            order.append(name)
+            durations[name] = 0.0
+        durations[name] += span_obj.duration_s
+    parts = [
+        f"{name};dur={durations[name] * 1000.0:.2f}"
+        for name in order[:max_entries]
+    ]
+    if trace.root.duration_s is not None:
+        parts.append(f"total;dur={trace.root.duration_s * 1000.0:.2f}")
+    return ", ".join(parts)
+
+
+# ---------------------------------------------------------------------------
 # tracer: trace factory + tail-sampled ring buffer
 
 
@@ -393,6 +441,8 @@ class Tracer:
         """Tail-sampling policy, in priority order. None = drop."""
         if trace.is_error:
             return "error"
+        if trace.force_keep:
+            return "forced"
         if trace.duration_s >= self.slow_threshold_s:
             return "slow"
         if self.sample_rate >= 1.0 or self._rng.random() < self.sample_rate:

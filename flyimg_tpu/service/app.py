@@ -11,7 +11,8 @@ app. Routes preserved exactly:
 plus the observability surface (docs/observability.md): /metrics,
 /healthz (liveness), /readyz (readiness — 503 while draining for
 shutdown), and — debug-gated — /debug/trace (jax.profiler capture),
-/debug/traces (tail-sampled trace ring), /debug/traces/{id} (span tree).
+/debug/traces (tail-sampled trace ring), /debug/traces/{id} (span tree),
+/debug/slo (burn rates / error budget), /debug/perf (batch efficiency).
 
 plus the ``encrypt`` CLI subcommand (reference app.php:93-96):
 
@@ -134,8 +135,18 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
     from flyimg_tpu.runtime.logging import access_log
     from flyimg_tpu.runtime.metrics import MetricsRegistry
 
-    metrics = MetricsRegistry()
+    from flyimg_tpu.runtime.slo import SloEngine
+
+    metrics = MetricsRegistry(
+        exemplars=bool(params.by_key("metrics_exemplars", True))
+    )
     tracer = tracing.Tracer.from_params(params, metrics=metrics)
+    # declarative SLOs evaluated over sliding windows (runtime/slo.py):
+    # flyimg_slo_* gauges, /debug/slo, breach log+span events
+    slo = SloEngine.from_params(params, metrics=metrics)
+    slo.register_metrics(metrics)
+    metrics.attach_slo(slo)
+    debug_enabled = bool(params.by_key("debug"))
     log_access = bool(params.by_key("log_access", True))
     storage = make_storage(params, metrics=metrics)
     import jax
@@ -308,6 +319,11 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
             inflight.dec()
             duration = time.perf_counter() - t0
             metrics.record_request(route, status)
+            if route in _TRACED_ROUTES:
+                # the SLI is the image pipeline, not probes or scrapes;
+                # record BEFORE tracer.finish so a breach's span event
+                # rides the triggering trace into the ring
+                slo.record(duration, ok=status < 500, trace=trace)
             if trace is not None:
                 trace.root.set_attribute("http.status", status)
                 tracer.finish(
@@ -321,6 +337,12 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
                             trace.trace_id, trace.root.span_id
                         )
                     )
+                    if debug_enabled:
+                        # per-request stage split from the span tree —
+                        # curl-visible without opening the trace ring
+                        st_header = tracing.server_timing(trace)
+                        if st_header:
+                            response.headers["Server-Timing"] = st_header
             if log_access:
                 access_log(
                     method=request.method,
@@ -469,7 +491,26 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
         url = storage.public_url(result.spec.name, base)
         return web.Response(text=url)
 
-    async def metrics_route(_request: web.Request) -> web.Response:
+    async def metrics_route(request: web.Request) -> web.Response:
+        """Prometheus scrape with content negotiation: clients that
+        Accept OpenMetrics get exemplars + the `# EOF` terminator; the
+        default text/plain response stays pure 0.0.4 (the classic text
+        parser has no exemplar syntax and would abort the whole scrape
+        on one)."""
+        openmetrics = (
+            "application/openmetrics-text"
+            in request.headers.get("Accept", "")
+        )
+        if openmetrics:
+            return web.Response(
+                text=metrics.render_prometheus(openmetrics=True),
+                headers={
+                    "Content-Type": (
+                        "application/openmetrics-text; version=1.0.0; "
+                        "charset=utf-8"
+                    )
+                },
+            )
         return web.Response(
             text=metrics.render_prometheus(),
             content_type="text/plain",
@@ -575,6 +616,43 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
             content_type="application/json",
         )
 
+    def _debug_gate_404() -> Optional[web.Response]:
+        """The perf-observability endpoints 404 (rather than 403) when
+        debug is off: they are pure operator surface and their existence
+        need not be advertised to the public internet."""
+        if not params.by_key("debug"):
+            return web.Response(status=404, text="not found")
+        return None
+
+    async def debug_slo(_request: web.Request) -> web.Response:
+        """Objective, windowed p99s, error-budget remaining, and
+        fast/slow burn rates as JSON (runtime/slo.py snapshot;
+        docs/observability.md "SLOs and burn rates")."""
+        import json as _json
+
+        denied = _debug_gate_404()
+        if denied is not None:
+            return denied
+        return web.Response(
+            text=_json.dumps(slo.snapshot()),
+            content_type="application/json",
+        )
+
+    async def debug_perf(_request: web.Request) -> web.Response:
+        """Batch-efficiency analytics: per-controller rolling occupancy /
+        padding waste / queue-wait share / compile amortization plus
+        per-stage and device-time quantiles (runtime/metrics.py
+        perf_snapshot; docs/observability.md "Batch efficiency")."""
+        import json as _json
+
+        denied = _debug_gate_404()
+        if denied is not None:
+            return denied
+        return web.Response(
+            text=_json.dumps(metrics.perf_snapshot()),
+            content_type="application/json",
+        )
+
     async def debug_traces_get(request: web.Request) -> web.Response:
         """Full span tree of one kept trace as JSON."""
         import json as _json
@@ -601,6 +679,8 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
     app.router.add_get("/debug/trace", debug_trace)
     app.router.add_get("/debug/traces", debug_traces_list)
     app.router.add_get("/debug/traces/{trace_id}", debug_traces_get)
+    app.router.add_get("/debug/slo", debug_slo)
+    app.router.add_get("/debug/perf", debug_perf)
     # Route table is config-overridable like the reference's
     # config/routes.yml (RoutesResolver.php); imageSrc uses a catch-all
     # pattern so full URLs (with slashes) work as path parameters — the

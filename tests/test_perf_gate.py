@@ -1,0 +1,112 @@
+"""Perf-regression gate: comparison math, calibration normalization,
+attribution on failure, and the end-to-end self-test — an unmodified
+tree passes, a fault-injected device slowdown fails with the device
+stage named (ISSUE 4 acceptance)."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools")
+)
+import perf_gate  # noqa: E402
+
+
+def _doc(stages_ms, calibration_ms=5.0):
+    return {
+        "schema": 1,
+        "repeats": 3,
+        "calibration_ms": calibration_ms,
+        "stages": {k: {"median_ms": v} for k, v in stages_ms.items()},
+    }
+
+
+BASE = {"decode": 10.0, "device": 40.0, "encode": 12.0,
+        "total": 65.0, "cache_hit": 8.0}
+
+
+def test_compare_passes_identical_measurements():
+    ok, report = perf_gate.compare(_doc(BASE), _doc(BASE), tolerance=1.5)
+    assert ok
+    assert all(r["verdict"] == "ok" for r in report["rows"])
+
+
+def test_compare_flags_regressed_stage_with_attribution():
+    current = dict(BASE, device=90.0)  # 2.25x the baseline
+    ok, report = perf_gate.compare(_doc(BASE), _doc(current), tolerance=1.5)
+    assert not ok
+    verdicts = {r["stage"]: r["verdict"] for r in report["rows"]}
+    assert verdicts["device"] == "REGRESSED"
+    assert verdicts["decode"] == "ok"
+    row = next(r for r in report["rows"] if r["stage"] == "device")
+    assert row["ratio"] == pytest.approx(2.25)
+
+
+def test_compare_normalizes_by_host_calibration():
+    """A uniformly 2x-slower host (calibration 2x) must NOT read as a
+    regression; a real 3x stage slowdown on that host still must."""
+    slower_host = {k: v * 2.0 for k, v in BASE.items()}
+    ok, report = perf_gate.compare(
+        _doc(BASE, calibration_ms=5.0),
+        _doc(slower_host, calibration_ms=10.0),
+        tolerance=1.5,
+    )
+    assert ok, report
+    slower_host["encode"] = BASE["encode"] * 6.0
+    ok, report = perf_gate.compare(
+        _doc(BASE, calibration_ms=5.0),
+        _doc(slower_host, calibration_ms=10.0),
+        tolerance=1.5,
+    )
+    assert not ok
+    row = next(r for r in report["rows"] if r["stage"] == "encode")
+    assert row["verdict"] == "REGRESSED"
+    assert row["ratio"] == pytest.approx(3.0)
+
+
+def test_compare_abs_slack_absorbs_sub_ms_jitter():
+    tiny = dict(BASE, decode=0.2)
+    jittered = dict(BASE, decode=0.9)  # 4.5x ratio but < 2 ms absolute
+    ok, _ = perf_gate.compare(_doc(tiny), _doc(jittered), tolerance=1.5)
+    assert ok
+
+
+def test_compare_reports_missing_stage():
+    partial = {k: v for k, v in BASE.items() if k != "encode"}
+    ok, report = perf_gate.compare(_doc(partial), _doc(BASE), tolerance=1.5)
+    row = next(r for r in report["rows"] if r["stage"] == "encode")
+    assert row["verdict"] == "missing"
+    assert ok  # missing is surfaced, not a regression verdict
+
+
+@pytest.mark.slow
+def test_gate_end_to_end_pass_then_injected_fail(tmp_path):
+    """The acceptance self-test: measure -> self-baseline -> --check
+    passes; with the device-stage latency spike armed, --check fails and
+    the report names the device stage."""
+    current = perf_gate.measure(repeats=4, warmup=2)
+    baseline_path = tmp_path / "baseline.json"
+    baseline_path.write_text(json.dumps(current))
+    rc = perf_gate.main([
+        "--check", "--baseline", str(baseline_path),
+        "--repeats", "4", "--warmup", "1", "--tolerance", "6.0",
+    ])
+    assert rc == 0
+    rc = perf_gate.main([
+        "--check", "--baseline", str(baseline_path),
+        "--repeats", "4", "--warmup", "1", "--tolerance", "6.0",
+        "--inject", "device=0.2",
+    ])
+    assert rc == 1
+
+
+def test_measure_produces_all_stages_quick():
+    doc = perf_gate.measure(repeats=2, warmup=1)
+    assert set(doc["stages"]) == set(perf_gate.STAGES)
+    assert all(
+        doc["stages"][s]["median_ms"] >= 0 for s in perf_gate.STAGES
+    )
+    assert doc["calibration_ms"] > 0

@@ -3,9 +3,11 @@
 A strict line grammar over live `/metrics` output: metric/label name
 charsets, label-value escaping, HELP-before-TYPE ordering, one contiguous
 block of samples per family, histogram `le` buckets cumulative and ending
-in `+Inf` with `_count` equal to the `+Inf` bucket. A scraper (or a
-crafted label value) should never be able to find a malformed line here —
-that is the satellite this test pins (ISSUE 2).
+in `+Inf` with `_count` equal to the `+Inf` bucket, and OpenMetrics
+exemplars (` # {trace_id="..."} value ts`) appearing ONLY on histogram
+`_bucket` lines with parseable label/value/timestamp parts. A scraper (or
+a crafted label value) should never be able to find a malformed line here
+— that is the satellite this test pins (ISSUE 2; exemplars ISSUE 4).
 """
 
 import math
@@ -20,8 +22,11 @@ _LABEL_NAME = r"[a-zA-Z_][a-zA-Z0-9_]*"
 # label values: any chars, with " \ and newline appearing ONLY escaped
 _LABEL_VALUE = r'(?:[^"\\\n]|\\\\|\\"|\\n)*'
 _LABEL = rf'{_LABEL_NAME}="{_LABEL_VALUE}"'
+# OpenMetrics exemplar suffix: ` # {labels} value [timestamp]`
+_EXEMPLAR = rf" # \{{({_LABEL}(?:,{_LABEL})*)\}} (\S+)(?: (\S+))?"
 _SAMPLE_RE = re.compile(
-    rf"^({_NAME})(?:\{{({_LABEL}(?:,{_LABEL})*)?\}})? (\S+)(?: \d+)?$"
+    rf"^({_NAME})(?:\{{({_LABEL}(?:,{_LABEL})*)?\}})? (\S+)(?: \d+)?"
+    rf"(?:{_EXEMPLAR})?$"
 )
 _HELP_RE = re.compile(rf"^# HELP ({_NAME}) (.*)$")
 _TYPE_RE = re.compile(
@@ -59,9 +64,16 @@ def parse_exposition(text: str):
     family_order = []  # first-seen order of sample families
     closed = set()  # families that already ended their contiguous block
     last_family = None
-    for lineno, line in enumerate(text.splitlines(), 1):
+    all_lines = text.splitlines()
+    for lineno, line in enumerate(all_lines, 1):
         assert line == line.rstrip(), f"trailing whitespace on line {lineno}"
         assert line, f"blank line {lineno} inside exposition"
+        if line == "# EOF":
+            # OpenMetrics terminator: legal only as the very last line
+            assert lineno == len(all_lines), (
+                f"# EOF before end of exposition (line {lineno})"
+            )
+            continue
         if line.startswith("# HELP"):
             m = _HELP_RE.match(line)
             assert m, f"malformed HELP line {lineno}: {line!r}"
@@ -84,6 +96,26 @@ def parse_exposition(text: str):
         m = _SAMPLE_RE.match(line)
         assert m, f"malformed sample line {lineno}: {line!r}"
         name, label_blob, value_token = m.group(1), m.group(2), m.group(3)
+        ex_labels, ex_value, ex_ts = m.group(4), m.group(5), m.group(6)
+        if ex_labels is not None:
+            # exemplars are legal ONLY on histogram bucket samples
+            assert name.endswith("_bucket"), (
+                f"exemplar on non-bucket line {lineno}: {line!r}"
+            )
+            consumed = 0
+            ex_parsed = {}
+            for lm in _LABEL_SPLIT_RE.finditer(ex_labels):
+                ex_parsed[lm.group(1)] = lm.group(2)
+                consumed = lm.end()
+            assert consumed == len(ex_labels), (
+                f"unparseable exemplar labels on line {lineno}"
+            )
+            assert "trace_id" in ex_parsed, (
+                f"exemplar without trace_id on line {lineno}"
+            )
+            _parse_value(ex_value)  # raises on malformed
+            if ex_ts is not None:
+                float(ex_ts)
         labels = {}
         if label_blob:
             consumed = 0
@@ -200,6 +232,95 @@ def test_exposition_values_parse_as_floats():
     )
     for _, name, _, value in samples:
         assert isinstance(value, float) or isinstance(value, int), name
+
+
+def test_exemplars_render_only_on_bucket_lines_with_trace_id():
+    """OpenMetrics exemplars (` # {trace_id=...} value ts`): attached to
+    the bucket a traced observation landed in, NEVER on _sum/_count/
+    counter/gauge lines, terminated by `# EOF`, and the whole output
+    still passes the strict grammar."""
+    from flyimg_tpu.runtime import tracing
+
+    reg = MetricsRegistry()
+    trace = tracing.Trace()
+    with tracing.activate(trace):
+        reg.record_stage("decode", 0.004)
+    reg.record_stage("decode", 0.008)  # untraced: no exemplar
+    reg.record_request("upload", 200)
+    reg.record_device_batch_seconds(0.02, trace_id=trace.trace_id)
+    text = reg.render_prometheus(openmetrics=True)
+    parse_exposition(text)  # grammar holds with exemplars present
+    assert text.endswith("# EOF\n")
+    exemplar_lines = [l for l in text.splitlines() if " # {" in l]
+    assert len(exemplar_lines) == 2  # one per traced histogram family
+    for line in exemplar_lines:
+        assert "_bucket{" in line
+        assert f'trace_id="{trace.trace_id}"' in line
+
+
+def test_plain_text_render_never_carries_exemplars():
+    """The default text/plain scrape is pure 0.0.4: classic Prometheus
+    text parsers have NO exemplar syntax and would abort the whole scrape
+    on a trailing `# {...}` token — exemplars only reach clients that
+    negotiated OpenMetrics."""
+    from flyimg_tpu.runtime import tracing
+
+    reg = MetricsRegistry()
+    trace = tracing.Trace()
+    with tracing.activate(trace):
+        reg.record_stage("decode", 0.004)
+    reg.record_device_batch_seconds(0.02, trace_id=trace.trace_id)
+    text = reg.render_prometheus()
+    assert " # {" not in text
+    assert "# EOF" not in text
+    parse_exposition(text)
+
+
+def test_exemplars_disabled_registry_renders_none():
+    from flyimg_tpu.runtime import tracing
+
+    reg = MetricsRegistry(exemplars=False)
+    trace = tracing.Trace()
+    with tracing.activate(trace):
+        reg.record_stage("decode", 0.004)
+    reg.record_device_batch_seconds(0.02, trace_id=trace.trace_id)
+    text = reg.render_prometheus(openmetrics=True)
+    assert " # {" not in text
+    parse_exposition(text)
+
+
+def test_exemplar_trace_id_escaped():
+    """A hostile trace id (only reachable via a forged traceparent that
+    slipped past parsing) must not corrupt the exposition format."""
+    reg = MetricsRegistry()
+    reg.record_device_batch_seconds(0.02, trace_id='evil"id}\n\\')
+    parse_exposition(reg.render_prometheus(openmetrics=True))
+
+
+def test_custom_bounds_histograms_conform():
+    """Batch-efficiency histograms use non-latency bounds (ratio ladder,
+    power-of-two bucket sizes) and must render as valid cumulative
+    histograms like every other family."""
+    reg = MetricsRegistry()
+    reg.record_batch_launch(
+        "device", images=3, capacity=4, queue_wait_s=0.002,
+        device_s=0.01, compile_hit=True,
+    )
+    reg.record_batch_launch(
+        "codec", images=8, capacity=8, queue_wait_s=0.0005,
+        device_s=0.003, compile_hit=None, aux=True,
+    )
+    samples, typed, _ = parse_exposition(reg.render_prometheus())
+    _check_histograms(samples, typed)
+    assert typed.get("flyimg_batch_occupancy_ratio") == "histogram"
+    assert typed.get("flyimg_batch_bucket_size") == "histogram"
+    assert typed.get("flyimg_batch_queue_wait_seconds") == "histogram"
+    controllers = {
+        labels.get("controller")
+        for _, name, labels, _ in samples
+        if name == "flyimg_batch_occupancy_ratio_bucket"
+    }
+    assert controllers == {"device", "codec"}
 
 
 def test_live_app_metrics_conform(tmp_path):

@@ -441,6 +441,36 @@ def test_route_pattern_override_keeps_tracing_and_labels(
         in metrics_text
 
 
+def test_server_timing_header_carries_stage_split(tmp_path, source_png):
+    """Debug-gated `Server-Timing`: a cache-miss response exposes the
+    fetch/decode/batch_wait/device/encode split (from the span tree) so
+    operators read the breakdown from curl without the trace ring."""
+
+    async def scenario(client):
+        resp = await client.get(f"/upload/w_27,o_png/{source_png}")
+        return resp.status, resp.headers.get("Server-Timing")
+
+    status, header = _serve(tmp_path, scenario)  # _params sets debug=True
+    assert status == 200 and header
+    for stage in ("fetch", "decode", "batch_wait", "device", "encode",
+                  "storage", "total"):
+        assert f"{stage};dur=" in header, (stage, header)
+    # every entry is `token;dur=float` — parseable by the browser rules
+    for part in header.split(", "):
+        name, _, dur = part.partition(";dur=")
+        assert name and float(dur) >= 0.0
+
+
+def test_server_timing_absent_when_debug_off(tmp_path, source_png):
+    async def scenario(client):
+        resp = await client.get(f"/upload/w_29,o_png/{source_png}")
+        return resp.status, resp.headers.get("Server-Timing")
+
+    status, header = _serve(tmp_path, scenario, debug=False)
+    assert status == 200
+    assert header is None
+
+
 def test_debug_traces_routes_gated_on_debug_param(tmp_path, source_png):
     async def scenario(client):
         listing = await client.get("/debug/traces")

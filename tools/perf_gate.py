@@ -1,0 +1,318 @@
+"""Perf-regression gate: a deterministic CPU-backend micro-suite with a
+checked-in baseline and per-stage attribution on failure.
+
+The BASELINE targets live in prose and bench artifacts; nothing gated a
+PR that quietly made decode 2x slower. This tool closes that gap:
+
+- ``--update`` runs the micro-suite and writes
+  ``benchmarks/perf_baseline.json`` (stage medians + a host-calibration
+  yardstick).
+- ``--check`` re-runs the suite, **normalizes by the calibration ratio**
+  (a faster/slower host shifts every stage together; the blake2b
+  yardstick cancels that), and fails (exit 1) when any stage's median
+  exceeds ``baseline * tolerance`` + an absolute jitter floor — printing
+  WHICH stage regressed and by how much.
+
+The workload is the handler's own cache-miss pipeline
+(``ImageHandler.transform_bytes`` — the exact code path serving runs),
+so the per-stage attribution (decode / device / encode / total) comes
+from the same ``timings`` dict the serving path reports, plus the
+cache-hit path via ``process_image``. Deterministic: seeded sources,
+CPU backend, sequential submits (every batch is a lone flush).
+
+``--inject device=0.05`` arms the fault harness with a latency spike at
+the ``batcher.execute`` point — the self-test proving the gate actually
+fails when a stage gets slower (tests/test_perf_gate.py runs it).
+
+CI: the ``perf-gate`` job runs ``--check`` with wide, CI-noise-tolerant
+bands (see .github/workflows/ci.yml). Baseline refresh policy:
+benchmarks/README.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+DEFAULT_BASELINE = os.path.join(
+    REPO_ROOT, "benchmarks", "perf_baseline.json"
+)
+STAGES = ("decode", "device", "encode", "total", "cache_hit")
+# absolute per-stage slack added on top of the relative band: sub-ms
+# stages on shared runners jitter by fractions of a ms that no relative
+# band should be asked to absorb
+ABS_SLACK_MS = 2.0
+SCHEMA = 1
+
+
+def _calibrate(rounds: int = 5) -> float:
+    """Host-speed yardstick: median seconds to blake2b-hash a fixed 4 MiB
+    buffer. Purely CPU-bound and allocation-free, so the baseline/current
+    ratio tracks single-core host speed — the factor every pipeline stage
+    shares — without touching any of the code under test."""
+    import hashlib
+
+    buf = b"\xa5" * (4 << 20)
+    times = []
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        hashlib.blake2b(buf).digest()
+        times.append(time.perf_counter() - t0)
+    return float(statistics.median(times))
+
+
+def _parse_inject(spec: str):
+    """'device=0.05' -> installs a latency spike at the stage's fault
+    point (only the device stage has one; the point is the proof that a
+    slowdown FAILS the gate, not a general stage simulator)."""
+    stage, _, seconds = spec.partition("=")
+    stage = stage.strip()
+    if stage != "device":
+        raise SystemExit(
+            f"--inject supports 'device=<seconds>' (got {spec!r}); the "
+            "device stage is the one with a batcher fault point"
+        )
+    return stage, float(seconds)
+
+
+def measure(repeats: int = 30, warmup: int = 3,
+            inject: str | None = None) -> dict:
+    """Run the micro-suite; returns {stages: {name: {median_ms}},
+    calibration_ms, repeats}. Import-heavy work happens here so --help
+    stays instant."""
+    from flyimg_tpu.parallel.mesh import ensure_env_platform
+
+    ensure_env_platform()
+
+    import numpy as np
+
+    from flyimg_tpu.appconfig import AppParameters
+    from flyimg_tpu.codecs import encode
+    from flyimg_tpu.runtime.batcher import BatchController
+    from flyimg_tpu.service.handler import ImageHandler
+    from flyimg_tpu.service.output_image import EXT_TO_MIME, OutputSpec
+    from flyimg_tpu.spec.options import OptionsBag
+    from flyimg_tpu.storage.local import LocalStorage
+    from flyimg_tpu.testing import faults
+
+    tmp = tempfile.mkdtemp(prefix="flyimg-perf-gate-")
+    params = AppParameters({
+        "tmp_dir": os.path.join(tmp, "t"),
+        "upload_dir": os.path.join(tmp, "u"),
+        "batch_deadline_ms": 0.5,
+    })
+    storage = LocalStorage(params)
+    batcher = BatchController(max_batch=8, deadline_ms=0.5)
+    handler = ImageHandler(storage, params, batcher=batcher)
+
+    injector = None
+    if inject:
+        stage, seconds = _parse_inject(inject)
+        injector = faults.FaultInjector()
+        injector.plan("batcher.execute", faults.latency_spike(seconds))
+        faults.install(injector)
+
+    rng = np.random.default_rng(20260803)
+    source = rng.integers(0, 255, (96, 128, 3), dtype=np.uint8)
+    data = encode(source, "png")
+    options_str = "w_48,h_36,c_1,o_png"
+
+    rows: dict = {stage: [] for stage in STAGES}
+    try:
+        def run_miss(tag: str) -> dict:
+            timings: dict = {}
+            options = OptionsBag(options_str)
+            spec = OutputSpec(
+                name=f"gate-{tag}.png", extension="png",
+                mime=EXT_TO_MIME["png"],
+            )
+            t0 = time.perf_counter()
+            handler.transform_bytes(data, options, spec, timings)
+            timings["total"] = time.perf_counter() - t0
+            return timings
+
+        for i in range(max(warmup, 1)):  # first run pays the XLA compile
+            run_miss(f"warm-{i}")
+        for i in range(repeats):
+            timings = run_miss(f"run-{i}")
+            for stage in ("decode", "device", "encode", "total"):
+                rows[stage].append(timings[stage])
+
+        # cache-hit path: populate once, then time pure hits through the
+        # full process_image choke point (security, options, storage)
+        src_path = os.path.join(tmp, "hit-source.png")
+        with open(src_path, "wb") as fh:
+            fh.write(data)
+        handler.process_image("w_40,h_30,o_png", src_path)
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            result = handler.process_image("w_40,h_30,o_png", src_path)
+            rows["cache_hit"].append(time.perf_counter() - t0)
+            assert result.from_cache
+    finally:
+        if injector is not None:
+            faults.clear()
+        batcher.close()
+
+    return {
+        "schema": SCHEMA,
+        "repeats": repeats,
+        "calibration_ms": round(_calibrate() * 1000.0, 4),
+        "stages": {
+            stage: {
+                "median_ms": round(
+                    statistics.median(values) * 1000.0, 4
+                )
+            }
+            for stage, values in rows.items()
+        },
+    }
+
+
+def compare(baseline: dict, current: dict, tolerance: float,
+            abs_slack_ms: float = ABS_SLACK_MS):
+    """-> (ok, report_rows). A stage regresses when its current median
+    exceeds ``baseline * scale * tolerance + abs_slack_ms`` where
+    ``scale`` is the host-calibration ratio (current / baseline hosts)."""
+    cal_base = float(baseline.get("calibration_ms") or 0.0)
+    cal_now = float(current.get("calibration_ms") or 0.0)
+    scale = (cal_now / cal_base) if cal_base > 0 and cal_now > 0 else 1.0
+    rows = []
+    ok = True
+    for stage in STAGES:
+        base = baseline["stages"].get(stage, {}).get("median_ms")
+        cur = current["stages"].get(stage, {}).get("median_ms")
+        if base is None or cur is None:
+            rows.append({
+                "stage": stage, "verdict": "missing",
+                "baseline_ms": base, "current_ms": cur,
+            })
+            continue
+        allowed = base * scale * tolerance + abs_slack_ms
+        ratio = cur / (base * scale) if base * scale > 0 else float("inf")
+        regressed = cur > allowed
+        ok = ok and not regressed
+        rows.append({
+            "stage": stage,
+            "baseline_ms": base,
+            "scaled_baseline_ms": round(base * scale, 4),
+            "current_ms": cur,
+            "ratio": round(ratio, 3),
+            "allowed_ms": round(allowed, 4),
+            "verdict": "REGRESSED" if regressed else "ok",
+        })
+    return ok, {"scale": round(scale, 4), "tolerance": tolerance,
+                "rows": rows}
+
+
+def _print_report(report: dict, ok: bool) -> None:
+    print(
+        f"host-calibration scale {report['scale']}x, "
+        f"tolerance {report['tolerance']}x"
+    )
+    print(
+        f"{'stage':<10} {'baseline':>10} {'scaled':>10} {'current':>10} "
+        f"{'ratio':>7} {'allowed':>10}  verdict"
+    )
+    for row in report["rows"]:
+        if row["verdict"] == "missing":
+            print(f"{row['stage']:<10} {'-':>10} {'-':>10} "
+                  f"{row['current_ms'] or '-':>10}  missing from baseline")
+            continue
+        print(
+            f"{row['stage']:<10} {row['baseline_ms']:>9.2f}m "
+            f"{row['scaled_baseline_ms']:>9.2f}m {row['current_ms']:>9.2f}m "
+            f"{row['ratio']:>6.2f}x {row['allowed_ms']:>9.2f}m  "
+            f"{row['verdict']}"
+        )
+    if ok:
+        print("perf gate: PASS")
+    else:
+        slowest = [
+            r for r in report["rows"] if r.get("verdict") == "REGRESSED"
+        ]
+        attribution = ", ".join(
+            f"{r['stage']} {r['ratio']}x over scaled baseline"
+            for r in slowest
+        )
+        print(f"perf gate: FAIL — {attribution}")
+
+
+def main(argv=None) -> int:
+    from flyimg_tpu.appconfig import AppParameters
+
+    defaults = AppParameters()
+    ap = argparse.ArgumentParser(prog="perf-gate", description=__doc__)
+    mode = ap.add_mutually_exclusive_group(required=True)
+    mode.add_argument(
+        "--check", action="store_true",
+        help="compare against the checked-in baseline; exit 1 on regression",
+    )
+    mode.add_argument(
+        "--update", action="store_true",
+        help="measure and (re)write the baseline file",
+    )
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument(
+        "--tolerance", type=float,
+        default=float(defaults.by_key("perf_gate_tolerance", 1.6)),
+        help="relative band: regression when current > baseline*scale*tol",
+    )
+    ap.add_argument(
+        "--repeats", type=int,
+        default=int(defaults.by_key("perf_gate_repeats", 30)),
+    )
+    ap.add_argument(
+        "--warmup", type=int,
+        default=int(defaults.by_key("perf_gate_warmup", 3)),
+    )
+    ap.add_argument(
+        "--inject", default=None, metavar="STAGE=SECONDS",
+        help="arm a latency-spike fault (device=0.05) to prove the gate "
+             "fails on a real slowdown",
+    )
+    ap.add_argument(
+        "--json", action="store_true",
+        help="also print the full current measurement as one JSON line",
+    )
+    ns = ap.parse_args(argv)
+
+    current = measure(
+        repeats=ns.repeats, warmup=ns.warmup, inject=ns.inject
+    )
+    if ns.json:
+        print(json.dumps(current))
+
+    if ns.update:
+        os.makedirs(os.path.dirname(ns.baseline), exist_ok=True)
+        with open(ns.baseline, "w") as fh:
+            json.dump(current, fh, indent=1)
+            fh.write("\n")
+        print(f"wrote {ns.baseline}")
+        for stage, doc in current["stages"].items():
+            print(f"  {stage:<10} {doc['median_ms']:9.2f} ms")
+        return 0
+
+    if not os.path.exists(ns.baseline):
+        print(
+            f"no baseline at {ns.baseline} — run --update first",
+            file=sys.stderr,
+        )
+        return 2
+    with open(ns.baseline) as fh:
+        baseline = json.load(fh)
+    ok, report = compare(baseline, current, ns.tolerance)
+    _print_report(report, ok)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

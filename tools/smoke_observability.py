@@ -113,8 +113,28 @@ async def main() -> int:
             "fault plan fired twice (fail then succeed)",
         )
 
-        # /metrics parses under the strict grammar, histograms coherent
-        metrics_text = await (await client.get("/metrics")).text()
+        # /metrics parses under the strict grammar, histograms coherent.
+        # The plain scrape must stay pure 0.0.4 (no exemplar syntax —
+        # classic parsers abort on it); the OpenMetrics-negotiated scrape
+        # carries the exemplars and the # EOF terminator.
+        plain_resp = await client.get("/metrics")
+        plain_text = await plain_resp.text()
+        _require(" # {" not in plain_text, "plain scrape exemplar-free")
+        parse_exposition(plain_text)
+        om_resp = await client.get(
+            "/metrics",
+            headers={"Accept": "application/openmetrics-text"},
+        )
+        _require(
+            "application/openmetrics-text" in om_resp.headers.get(
+                "Content-Type", ""
+            ),
+            "openmetrics content type negotiated",
+        )
+        metrics_text = await om_resp.text()
+        _require(
+            metrics_text.endswith("# EOF\n"), "openmetrics EOF terminator"
+        )
         samples, typed, _ = parse_exposition(metrics_text)
         _check_histograms(samples, typed)
         names = {name for _, name, _, _ in samples}
@@ -125,8 +145,47 @@ async def main() -> int:
             "flyimg_compile_events_total",
             "flyimg_inflight_requests",
             "flyimg_batcher_queue_depth",
+            # SLO engine gauge surface (runtime/slo.py)
+            "flyimg_slo_burn_rate_fast",
+            "flyimg_slo_burn_rate_slow",
+            "flyimg_slo_error_budget_remaining",
+            "flyimg_slo_window_p99_ms",
+            # batch-efficiency histograms (runtime/metrics.py)
+            "flyimg_batch_occupancy_ratio_bucket",
+            "flyimg_batch_queue_wait_seconds_bucket",
         ):
             _require(expected in names, f"metric family {expected}")
+        # at least one OpenMetrics exemplar linking a latency bucket to
+        # the traced request's trace id, on a _bucket line only
+        exemplar_lines = [
+            l for l in metrics_text.splitlines() if " # {" in l
+        ]
+        _require(bool(exemplar_lines), "an exemplar in /metrics")
+        _require(
+            all("_bucket{" in l for l in exemplar_lines),
+            "exemplars only on _bucket lines",
+        )
+        _require(
+            any(f'trace_id="{tid}"' in l for l in exemplar_lines),
+            "an exemplar carrying the traced request's trace id",
+        )
+
+        # the perf-observability endpoints serve coherent JSON
+        slo_doc = await (await client.get("/debug/slo")).json()
+        _require(slo_doc.get("enabled") is True, "/debug/slo enabled")
+        _require(
+            slo_doc["objective"]["latency_p99_ms"] > 0, "slo objective"
+        )
+        _require(
+            slo_doc["windows"]["fast"]["requests"] >= 1,
+            "slo fast window saw the request",
+        )
+        perf_doc = await (await client.get("/debug/perf")).json()
+        _require(
+            perf_doc["controllers"]["device"]["window_batches"] >= 1,
+            "/debug/perf device controller stats",
+        )
+        _require("decode" in perf_doc["stages"], "/debug/perf stage rows")
 
         # the trace is retrievable and its span tree is well-formed
         detail = await client.get(f"/debug/traces/{tid}")
