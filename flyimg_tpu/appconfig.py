@@ -138,6 +138,52 @@ SERVER_DEFAULTS: Dict[str, Any] = {
     "perf_gate_tolerance": 1.6,
     "perf_gate_repeats": 30,
     "perf_gate_warmup": 3,
+    # --- graceful degradation under overload (runtime/brownout.py;
+    # docs/degradation.md). EVERYTHING here defaults off/fail-safe:
+    # with the defaults the serving path is byte-for-byte the
+    # non-brownout behavior (pinned by tests/test_brownout.py) ---
+    # master switch for the NORMAL->DEGRADED->BROWNOUT->SHED engine
+    "brownout_enable": False,
+    # pressure thresholds (normalized: 1.0 ~ at capacity) that enter
+    # each level; escalation is immediate
+    "brownout_degraded_at": 0.6,
+    "brownout_brownout_at": 0.85,
+    "brownout_shed_at": 1.1,
+    # de-escalation gap: drop a level only when pressure < threshold *
+    # hysteresis (and after the dwell) — prevents flapping at a boundary
+    "brownout_hysteresis": 0.75,
+    # minimum seconds at a level before de-escalating (one level at a time)
+    "brownout_min_dwell_s": 5.0,
+    # pressure re-evaluation cadence (per-request calls cheaper than this
+    # reuse the last answer)
+    "brownout_eval_interval_s": 0.25,
+    # queue-depth normalization reference: pending submissions at which
+    # queue pressure reads 1.0 (0 = batch_max_queue_depth, else 64)
+    "brownout_queue_ref": 0.0,
+    # optional extra signals: inflight requests / open breakers at which
+    # those pressures read 1.0 (0 = signal ignored)
+    "brownout_inflight_ref": 0.0,
+    "brownout_breaker_ref": 0.0,
+    # BROWNOUT plan rewriting: encode quality clamp for degraded renders
+    "brownout_quality": 40,
+    # DEGRADED+ stale-while-revalidate: a cache hit older than this
+    # serves immediately with stale markers while one coalesced
+    # background refresh re-renders it
+    "brownout_stale_ttl_s": 300.0,
+    # bound on queued background refreshes (over it, refreshes drop —
+    # the refresh queue must not amplify the overload it exists to ride)
+    "brownout_refresh_max_pending": 8,
+    # --- negative origin cache (runtime/brownout.py NegativeCache) ---
+    # seconds a failing origin (retry-exhausted transient errors, open
+    # breaker) short-circuits repeat fetches of the same host+path to an
+    # immediate 502; 0 disables the table
+    "negative_cache_ttl_s": 0.0,
+    "negative_cache_max_entries": 1024,
+    # --- hedged storage reads (storage/base.py fetch_hedged) ---
+    # ms without a primary cache-read result before ONE backup read is
+    # fired and the winner served (bounds cache-hit tail latency when
+    # the backing store stalls); 0 disables hedging
+    "storage_hedge_delay_ms": 0.0,
 }
 
 

@@ -79,21 +79,42 @@ MAX_PREDICTOR_BYTES = 48 * 1024 * 1024
 # at the 48 MB cap would still burn ~18 s of CPU per request, so SCALAR
 # rows get their own much tighter cumulative ceiling (~5 s worst case;
 # covers an A4 300-dpi gray scan even if its encoder chose Paeth for
-# every row — bigger all-Paeth documents go to ghostscript).
+# every row — bigger all-Paeth documents go to ghostscript). The budget
+# is DOCUMENT-wide when decoding through a MiniPdf (one shared counter
+# across every stream), not per-stream: N hostile streams in one
+# document must not multiply the ceiling by N. Legitimate multi-page
+# scans get one extra base budget per page up to a small cap — total
+# CPU stays bounded (~cap x 5 s) whatever the document declares, while
+# a benign 2-3 page all-Paeth scan still decodes.
 MAX_PREDICTOR_SCALAR_BYTES = 12 * 1024 * 1024
+MAX_SCALAR_BUDGET_PAGES = 3
 
 
-def _png_unfilter(data: bytes, columns: int, colors: int) -> bytes:
+def _png_unfilter(data: bytes, columns: int, colors: int,
+                  consume_scalar=None) -> bytes:
     """Reverse PNG row filters (predictors 10-15: each row is one filter
     byte + filtered samples). 8-bit samples only — that covers xref/object
     streams (W-width integer columns) and the 8bpc images this subset
     admits. 'none'/'up'/'sub' rows are vectorized; 'average'/'paeth' run a
     bytearray scalar loop (C-speed indexing), with total input bounded by
-    MAX_PREDICTOR_BYTES so hostile all-Paeth streams cost bounded CPU."""
+    MAX_PREDICTOR_BYTES and scalar rows debited from ``consume_scalar``
+    (MiniPdf passes its DOCUMENT-wide counter; standalone callers get a
+    fresh per-call budget) so hostile all-Paeth streams cost bounded CPU
+    however many of them a document carries."""
     if columns <= 0 or colors <= 0:
         raise PdfRefusal("bad predictor geometry")
     if len(data) > MAX_PREDICTOR_BYTES:
         raise PdfRefusal("predictor stream exceeds the size ceiling")
+    if consume_scalar is None:
+        local = [MAX_PREDICTOR_SCALAR_BYTES]
+
+        def consume_scalar(n: int, _left=local) -> None:
+            _left[0] -= n
+            if _left[0] < 0:
+                raise PdfRefusal(
+                    "predictor stream exceeds the average/Paeth CPU ceiling"
+                )
+
     rowlen = columns * colors
     stride = rowlen + 1
     nrows, rem = divmod(len(data), stride)
@@ -103,7 +124,6 @@ def _png_unfilter(data: bytes, columns: int, colors: int) -> bytes:
     out = bytearray(nrows * rowlen)
     prev = bytes(rowlen)
     mv = memoryview(data)
-    scalar_bytes = 0
     for r in range(nrows):
         ft = data[r * stride]
         row = mv[r * stride + 1 : (r + 1) * stride]
@@ -120,11 +140,7 @@ def _png_unfilter(data: bytes, columns: int, colors: int) -> bytes:
                 np.uint8
             ).tobytes()
         elif ft in (3, 4):
-            scalar_bytes += rowlen
-            if scalar_bytes > MAX_PREDICTOR_SCALAR_BYTES:
-                raise PdfRefusal(
-                    "predictor stream exceeds the average/Paeth CPU ceiling"
-                )
+            consume_scalar(rowlen)
             rb = bytes(row)
             buf = bytearray(rowlen)
             for i in range(rowlen):
@@ -158,9 +174,12 @@ def _png_unfilter(data: bytes, columns: int, colors: int) -> bytes:
     return bytes(out)
 
 
-def _apply_decode_parms(data: bytes, parms, ncomp_default: int = 1) -> bytes:
+def _apply_decode_parms(data: bytes, parms, ncomp_default: int = 1,
+                        consume_scalar=None) -> bytes:
     """Apply a fully-RESOLVED FlateDecode /DecodeParms dict to inflated
-    bytes (callers resolve indirect refs/arrays via MiniPdf._parms_for)."""
+    bytes (callers resolve indirect refs/arrays via MiniPdf._parms_for).
+    ``consume_scalar`` threads the document-wide scalar-predictor budget
+    through to ``_png_unfilter``."""
     if parms is None:
         return data
     if not isinstance(parms, dict):
@@ -174,7 +193,7 @@ def _apply_decode_parms(data: bytes, parms, ncomp_default: int = 1) -> bytes:
         raise PdfRefusal("predictor BitsPerComponent != 8 unsupported")
     columns = int(parms.get("Columns", 1) or 1)
     colors = int(parms.get("Colors", ncomp_default) or ncomp_default)
-    return _png_unfilter(data, columns, colors)
+    return _png_unfilter(data, columns, colors, consume_scalar=consume_scalar)
 
 
 # ---------------------------------------------------------------- tokenizer
@@ -316,10 +335,16 @@ _OBJ_RE = re.compile(rb"(\d+)\s+(\d+)\s+obj\b")
 class MiniPdf:
     """Image-only PDF document: object map + page list + rasterize()."""
 
-    def __init__(self, data: bytes):
+    def __init__(self, data: bytes,
+                 scalar_predictor_budget: int = MAX_PREDICTOR_SCALAR_BYTES):
         if not data.lstrip()[:5] == b"%PDF-":
             raise PdfRefusal("not a PDF (missing %PDF- header)")
         self.data = data
+        # DOCUMENT-wide average/Paeth predictor CPU budget: every stream
+        # this document decodes debits one shared counter, so N hostile
+        # streams cannot multiply the per-stream ceiling N-fold
+        # (injectable for fast tests)
+        self._scalar_budget_left = int(scalar_predictor_budget)
         self.objects: dict[int, tuple[object, bytes | None]] = {}
         # byte offset each object number was defined at (ObjStm-packed
         # objects inherit their container's offset) — incremental-update
@@ -328,6 +353,16 @@ class MiniPdf:
         self._scan_objects()
         self._unpack_objstms()
         self.pages = self._collect_pages()
+        # page-scaled budget (see MAX_SCALAR_BUDGET_PAGES): granted only
+        # AFTER the page tree parses — xref/ObjStm predictor streams are
+        # tiny integer tables, well inside the base budget — and only
+        # when the caller used the default base (an injected test budget
+        # stays exact)
+        if scalar_predictor_budget == MAX_PREDICTOR_SCALAR_BYTES:
+            extra_pages = min(len(self.pages), MAX_SCALAR_BUDGET_PAGES) - 1
+            self._scalar_budget_left += (
+                extra_pages * MAX_PREDICTOR_SCALAR_BYTES
+            )
 
     # -- object layer
 
@@ -487,6 +522,16 @@ class MiniPdf:
             raise PdfRefusal(f"unsupported /DecodeParms {parms!r}")
         return {k: self.resolve(v) for k, v in parms.items()}
 
+    def _consume_scalar_budget(self, n: int) -> None:
+        """Debit ``n`` scalar-predictor bytes from the document-wide
+        budget (passed into ``_png_unfilter`` by every decode path)."""
+        self._scalar_budget_left -= n
+        if self._scalar_budget_left < 0:
+            raise PdfRefusal(
+                "document exceeds the cumulative average/Paeth predictor "
+                "CPU ceiling"
+            )
+
     def _decode_stream_data(self, obj: dict, raw: bytes) -> bytes:
         filters = self.resolve(obj.get("Filter"))
         if filters is None:
@@ -499,7 +544,10 @@ class MiniPdf:
             f = self.resolve(f)
             if f == "FlateDecode":
                 out = _bounded_inflate(out)
-                out = _apply_decode_parms(out, self._parms_for(parms, i))
+                out = _apply_decode_parms(
+                    out, self._parms_for(parms, i),
+                    consume_scalar=self._consume_scalar_budget,
+                )
             else:
                 raise PdfRefusal(f"content-stream filter {f!r} unsupported")
         return out
@@ -603,6 +651,7 @@ class MiniPdf:
                 data = _apply_decode_parms(
                     data, self._parms_for(obj.get("DecodeParms"), 0),
                     ncomp_default=ncomp,
+                    consume_scalar=self._consume_scalar_budget,
                 )
             else:
                 data = raw

@@ -42,6 +42,15 @@ class UnsupportedMediaException(AppException):
     them at runtime instead."""
 
 
+class OriginUnavailableException(AppException):
+    """The source origin is negative-cached as recently failing
+    (runtime/brownout.py NegativeCache): the fetch short-circuits to an
+    immediate 502 instead of burning connect/read timeouts and deadline
+    budget re-proving a dead origin. Distinct from ReadFileException
+    (404: THIS source could not be read) — a 502 tells the caller the
+    upstream, not the request, is the problem."""
+
+
 class ServiceUnavailableException(AppException):
     """The service is shedding this request: a wedged device pipeline, a
     full admission queue, or an open upstream circuit. Maps to 503 (+

@@ -244,6 +244,42 @@ def smart_crop_image(rgb: np.ndarray) -> np.ndarray:
     return apply_crop(rgb, find_best_crop(rgb, 100, 100))
 
 
+def entropy_crop_image(rgb: np.ndarray) -> np.ndarray:
+    """Brownout-mode substitute for ``smart_crop_image`` (runtime/
+    brownout.py; docs/degradation.md): the same square output contract —
+    a side-``min(h, w)`` window — chosen by a pure host heuristic
+    instead of the batched device scoring pass. The window slides along
+    the long axis on the scorer's stride-8 grid and lands where summed
+    gradient energy (|∇luma|, the cheap stand-in for entropy) is
+    highest, ties going to the more central position — deterministic,
+    O(W·H) numpy, no device work, no BlazeFace/feature program."""
+    h, w = rgb.shape[:2]
+    side = min(h, w)
+    if h == w:
+        return rgb
+    luma = rgb.astype(np.float32).mean(axis=2)
+    axis = 0 if h > w else 1
+    # per-line energy along the long axis: gradient magnitude summed over
+    # the short axis, then a sliding-window sum via one cumsum
+    grad = np.abs(np.diff(luma, axis=axis)).sum(axis=1 - axis)
+    grad = np.concatenate([grad, [0.0]])
+    csum = np.concatenate([[0.0], np.cumsum(grad)])
+    span = (h if axis == 0 else w) - side
+    offsets = np.arange(0, span + 1, 8)
+    if offsets[-1] != span:
+        offsets = np.concatenate([offsets, [span]])
+    window = csum[offsets + side] - csum[offsets]
+    # strict argmax-first-win would bias toward the top/left edge on flat
+    # images; prefer the candidate nearest center among near-ties
+    best = window.max()
+    near = offsets[window >= best * 0.999999]
+    center = span / 2.0
+    off = int(near[np.argmin(np.abs(near - center))])
+    if axis == 0:
+        return np.ascontiguousarray(rgb[off:off + side])
+    return np.ascontiguousarray(rgb[:, off:off + side])
+
+
 # ---------------------------------------------------------------------------
 # batched serving path: many images -> crops in ONE device launch per
 # shape bucket (the program bench.py measures is batched; serving must be

@@ -579,7 +579,9 @@ class BatchController:
         with self._lock:
             self._stop = True
             self._lock.notify_all()
-        self._thread.join(timeout=5)
+        # a wedged executor cannot be joined; don't let the join spend
+        # more than the caller's whole drain budget waiting for it
+        self._thread.join(timeout=min(5.0, max(drain_timeout_s, 0.1)))
         # BOUNDED drain: resolve every in-flight readback before the
         # controller dies — callers (serving shutdown, bulk sweeps) still
         # hold those futures — but a tunnel-hung read must not wedge
@@ -634,10 +636,17 @@ class BatchController:
                     # replacement's accounting is never clobbered)
                     self._busy_since = time.monotonic()
                     self._busy_owner = me
+                    # register the batch as in flight BEFORE any dispatch
+                    # work: close()'s drain snapshot must see a batch
+                    # whose dispatch is still executing (or wedged at a
+                    # fault gate) and timeout-stamp its futures, instead
+                    # of returning while callers block forever
+                    self._inflight_batches.append(group.members)
             if group is None:
                 continue
+            handed_off = False
             try:
-                self._execute(group)
+                handed_off = self._execute(group)
             except Exception as exc:  # pragma: no cover - _execute
                 # contains its own failure handling; this is the last
                 # line keeping the singleton executor alive
@@ -652,6 +661,15 @@ class BatchController:
                 )
                 self._clear_busy(me)
                 raise
+            finally:
+                if not handed_off:
+                    # every non-pipelined outcome (aux batch, recovery,
+                    # dispatch failure, executor death) resolved the
+                    # members on this thread; a handed-off batch stays
+                    # registered until its drain thread finishes
+                    with self._lock:
+                        if group.members in self._inflight_batches:
+                            self._inflight_batches.remove(group.members)
             self._clear_busy(me)
 
     def _clear_busy(self, me: threading.Thread) -> None:
@@ -785,7 +803,12 @@ class BatchController:
         )
         return span_obj
 
-    def _execute(self, group: _Group) -> None:
+    def _execute(self, group: _Group):
+        """Run one popped group. Returns True when the batch was handed
+        off to a drain thread (it stays registered in
+        ``_inflight_batches`` until the drain finishes); every other
+        outcome resolves the members synchronously and returns falsy so
+        ``_run`` deregisters the batch."""
         members = group.members
         n = len(members)
         # capture the id under the lock: drain-thread recovery launches
@@ -902,8 +925,9 @@ class BatchController:
                 with jax.profiler.TraceAnnotation(f"flyimg:batch:{seq}"):
                     dev_out = fn(*(jnp.asarray(a) for a in arrays))
                 self._touch_busy()  # dispatch returned: progress
-                with self._lock:
-                    self._inflight_batches.append(members)
+                # the batch was registered in _inflight_batches by _run
+                # BEFORE dispatch (close()-drain visibility); ownership
+                # now passes to the drain thread, whose finally removes it
                 threading.Thread(
                     target=self._drain,
                     args=(
@@ -913,11 +937,9 @@ class BatchController:
                     name="flyimg-batcher-drain",
                     daemon=True,
                 ).start()
+                return True
             except BaseException:
                 inflight.release()
-                with self._lock:
-                    if members in self._inflight_batches:
-                        self._inflight_batches.remove(members)
                 raise
         except Exception as exc:
             if span_obj is not None and span_obj.duration_s is None:

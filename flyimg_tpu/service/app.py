@@ -12,7 +12,8 @@ plus the observability surface (docs/observability.md): /metrics,
 /healthz (liveness), /readyz (readiness — 503 while draining for
 shutdown), and — debug-gated — /debug/trace (jax.profiler capture),
 /debug/traces (tail-sampled trace ring), /debug/traces/{id} (span tree),
-/debug/slo (burn rates / error budget), /debug/perf (batch efficiency).
+/debug/slo (burn rates / error budget), /debug/perf (batch efficiency),
+/debug/brownout (degradation level + pressure components).
 
 plus the ``encrypt`` CLI subcommand (reference app.php:93-96):
 
@@ -40,6 +41,7 @@ from flyimg_tpu.exceptions import (
     DeadlineExceededException,
     ExecFailedException,
     InvalidArgumentException,
+    OriginUnavailableException,
     ReadFileException,
     SecurityException,
     ServiceUnavailableException,
@@ -77,6 +79,9 @@ _ERROR_STATUS = {
     InvalidArgumentException: 400,
     UnsupportedMediaException: 415,
     DeadlineExceededException: 504,
+    # negative-cached origin (runtime/brownout.py NegativeCache): the
+    # upstream, not this request, is the problem — a fast 502
+    OriginUnavailableException: 502,
     ServiceUnavailableException: 503,
     ExecFailedException: 500,
 }
@@ -258,9 +263,19 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
         str(params.by_key("face_backend", "auto")),
         params.by_key("face_checkpoint"),
     )
+    # brownout/degradation engine (runtime/brownout.py): consumes the
+    # pressure signals wired below and drives the per-level degradation
+    # policies inside the handler. Disabled by default — with
+    # brownout_enable false the handler paths it guards are never taken
+    # and responses are byte-for-byte the pre-brownout behavior.
+    from flyimg_tpu.runtime.brownout import BrownoutEngine
+
+    brownout = BrownoutEngine.from_params(params, metrics=metrics)
+    brownout.register_metrics(metrics)
     handler = ImageHandler(
         storage, params, batcher=batcher, codec_batcher=codec_batcher,
         face_backend=face_backend, metrics=metrics, sp_mesh=sp_mesh,
+        brownout=brownout,
     )
     # state gauges (runtime/metrics.py Gauge): sampled at /metrics render
     inflight = metrics.gauge(
@@ -275,6 +290,16 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
         "flyimg_traces_buffered",
         "Traces held in the tail-sampling ring buffer",
         fn=lambda: len(tracer),
+    )
+    # the engine's pressure sources: batcher queue depth + efficiency
+    # window, SLO burn rates, the inflight gauge, breaker-open count
+    brownout.attach(
+        batchers=(batcher, codec_batcher),
+        slo=slo,
+        # Gauge.value is a property: wrap it so the engine samples the
+        # LIVE value each evaluation, not the attach-time float
+        inflight_fn=lambda: inflight.value,
+        breaker_open_fn=handler.fetch_policy.breakers.open_count,
     )
 
     @web.middleware
@@ -297,6 +322,15 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
         trace = None
         if route in _TRACED_ROUTES:
             trace = tracer.start(request.headers.get("traceparent"))
+            # brownout pressure re-evaluation rides the request path
+            # (rate-limited inside the engine; disabled = one bool
+            # check) so the level tracks load without a timer thread.
+            # It runs INSIDE this request's trace activation so a level
+            # transition's brownout.transition span event lands on the
+            # request that triggered it (add_event is a no-op with no
+            # ambient trace).
+            with tracing.activate(trace):
+                brownout.evaluate()
             if trace is not None:
                 trace.root.set_attribute("route", route)
                 trace.root.set_attribute("http.method", request.method)
@@ -653,6 +687,20 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
             content_type="application/json",
         )
 
+    async def debug_brownout(_request: web.Request) -> web.Response:
+        """Brownout engine state: level, pressure components, thresholds,
+        refresh-queue occupancy (runtime/brownout.py snapshot;
+        docs/degradation.md)."""
+        import json as _json
+
+        denied = _debug_gate_404()
+        if denied is not None:
+            return denied
+        return web.Response(
+            text=_json.dumps(brownout.snapshot()),
+            content_type="application/json",
+        )
+
     async def debug_traces_get(request: web.Request) -> web.Response:
         """Full span tree of one kept trace as JSON."""
         import json as _json
@@ -681,6 +729,7 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
     app.router.add_get("/debug/traces/{trace_id}", debug_traces_get)
     app.router.add_get("/debug/slo", debug_slo)
     app.router.add_get("/debug/perf", debug_perf)
+    app.router.add_get("/debug/brownout", debug_brownout)
     # Route table is config-overridable like the reference's
     # config/routes.yml (RoutesResolver.php); imageSrc uses a catch-all
     # pattern so full URLs (with slashes) work as path parameters — the

@@ -19,7 +19,7 @@ import time
 from concurrent.futures import Future
 from concurrent.futures import TimeoutError as FutureTimeout
 from dataclasses import dataclass, field
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -42,6 +42,7 @@ from flyimg_tpu.spec.plan import (
     TransformPlan,
     build_plan,
     decode_target_hint,
+    degrade_plan,
     parse_colorspace,
 )
 from flyimg_tpu.storage.base import Storage
@@ -93,6 +94,14 @@ class ProcessedImage:
     # stored artifact's mtime (reference Last-Modified source,
     # Response.php:72-78); None -> response falls back to now()
     modified_at: Optional[float] = None
+    # brownout markers (runtime/brownout.py; docs/degradation.md): the
+    # degradation modes applied to this render ("refine"/"smartcrop"/
+    # "quality"), and whether the bytes are a stale-while-revalidate
+    # serve of an expired cache entry. Both drive response headers
+    # (X-Flyimg-Degraded, Warning: 110) and stay empty/False — no new
+    # headers — whenever the brownout engine is off or NORMAL.
+    degraded: Tuple[str, ...] = ()
+    stale: bool = False
 
 
 class ImageHandler:
@@ -115,6 +124,7 @@ class ImageHandler:
         smartcrop_backend=None,
         metrics=None,
         sp_mesh=None,
+        brownout=None,
     ) -> None:
         self.storage = storage
         self.params = params
@@ -148,6 +158,10 @@ class ImageHandler:
         self.wedged_fallback = bool(
             params.by_key("wedged_executor_fallback", True)
         )
+        # brownout engine (runtime/brownout.py): per-level degradation —
+        # stale-while-revalidate, plan rewriting, miss shedding. None or
+        # disabled = today's behavior exactly (docs/degradation.md).
+        self.brownout = brownout
 
     # lazily import model backends so the service can run without them
     def _smartcrop(self):
@@ -229,9 +243,12 @@ class ImageHandler:
             self.storage.delete(spec.name)  # idempotent when absent
 
         # ONE round trip answers cached? + bytes + stored-when? (separate
-        # has/read/head calls would tax S3 serving's hot path 2-3x)
+        # has/read/head calls would tax S3 serving's hot path 2-3x).
+        # fetch_hedged == fetch when storage_hedge_delay_ms is 0; with it
+        # set, a stalled primary read races one backup read so the
+        # cache-hit tail is bounded by the hedge delay, not the stall.
         with tracing.span("storage", op="fetch"):
-            cached = None if refresh else self.storage.fetch(spec.name)
+            cached = None if refresh else self.storage.fetch_hedged(spec.name)
         if cached is not None and not _cache_entry_valid(cached[0], spec):
             # corrupt/truncated entry (torn write, disk damage, bucket
             # tampering): treat it as a miss — delete and re-render —
@@ -249,6 +266,31 @@ class ImageHandler:
         if cached is not None:
             content, stat = cached
             tracing.add_event("cache.hit", key=spec.name)
+            # stale-while-revalidate (runtime/brownout.py; DEGRADED+):
+            # an entry past its freshness TTL serves IMMEDIATELY with
+            # stale markers while ONE coalesced background refresh
+            # re-renders it — under pressure a slightly-old image beats
+            # a device-pipeline wait or a 503
+            stale = False
+            engine = self.brownout
+            if (
+                engine is not None
+                and engine.swr_active()
+                and stat.mtime is not None
+                and engine.stale_ttl_s > 0
+                and time.time() - stat.mtime > engine.stale_ttl_s
+            ):
+                stale = True
+                engine.record_degraded("stale")
+                tracing.add_event(
+                    "brownout.stale_hit", key=spec.name,
+                    age_s=round(time.time() - stat.mtime, 1),
+                )
+                if not engine.shed_active():
+                    # at SHED even refreshes stop: the queue bound
+                    # protects the device, but a shedding tier should
+                    # spend zero miss-pipeline work it can avoid
+                    self._schedule_refresh(spec, options, source.data)
             if self.metrics is not None:
                 self.metrics.record_cache(hit=True)
                 self.metrics.record_stage("cache_hit", time.perf_counter() - t0)
@@ -259,7 +301,21 @@ class ImageHandler:
                 from_cache=True,
                 timings=timings,
                 modified_at=stat.mtime,
+                stale=stale,
             )
+
+        # SHED level (runtime/brownout.py): cache misses reject before
+        # any decode/device work — hits and stale hits above still serve
+        engine = self.brownout
+        if engine is not None and engine.shed_active():
+            engine.record_degraded("shed")
+            tracing.add_event("brownout.shed", key=spec.name)
+            exc = ServiceUnavailableException(
+                "shedding cache-miss work under overload (brownout level "
+                "shed); cached outputs still serve"
+            )
+            exc.retry_after_s = max(1, int(engine.shed_retry_after_s))
+            raise exc
 
         leader, flight = self._singleflight.begin(spec.name)
         if not leader:
@@ -273,7 +329,7 @@ class ImageHandler:
                 # waits) must NOT shed its followers — only a wedged one.
                 # The follower's own deadline caps the wait regardless.
                 with tracing.span("coalesced_wait", key=spec.name):
-                    content, modified_at = flight.result(
+                    content, modified_at, degraded = flight.result(
                         timeout=deadline.timeout(
                             5 * self.device_result_timeout_s
                         )
@@ -297,21 +353,47 @@ class ImageHandler:
                 ).inc()
             return ProcessedImage(
                 content=content, spec=spec, options=options, timings=timings,
-                modified_at=modified_at,
+                modified_at=modified_at, degraded=degraded,
             )
 
         try:
-            content = self._process_new(
-                source.data, options, spec, timings, deadline=deadline
+            # BROWNOUT+ plan degradation: finishing ops dropped, device
+            # smart-crop swapped for the host entropy crop, encode
+            # quality clamped (docs/degradation.md). modes stays empty
+            # whenever the engine is off or below BROWNOUT.
+            modes: List[str] = []
+            degrade = (
+                engine
+                if engine is not None and engine.plan_degrade_active()
+                else None
             )
-            # write() returns the stored mtime so neither the leader nor
-            # its followers re-query metadata for bytes written just now
-            with tracing.span("storage", op="write", bytes=len(content)):
-                modified_at = self.storage.write(spec.name, content)
+            content = self._process_new(
+                source.data, options, spec, timings, deadline=deadline,
+                degrade=degrade, degraded_out=modes,
+            )
+            if modes:
+                # degraded renders are served direct, never cached: the
+                # cache must only ever hold full-quality bytes, or a
+                # brownout would poison it for a year of CDN max-age
+                modified_at = None
+                for mode in modes:
+                    engine.record_degraded(mode)
+                tracing.add_event(
+                    "brownout.degraded_render", key=spec.name,
+                    modes=",".join(modes),
+                )
+            else:
+                # write() returns the stored mtime so neither the leader
+                # nor its followers re-query metadata for bytes written
+                # just now
+                with tracing.span("storage", op="write", bytes=len(content)):
+                    modified_at = self.storage.write(spec.name, content)
         except BaseException as exc:
             self._singleflight.done(spec.name, exc=exc)
             raise
-        self._singleflight.done(spec.name, result=(content, modified_at))
+        self._singleflight.done(
+            spec.name, result=(content, modified_at, tuple(modes))
+        )
         timings["total"] = time.perf_counter() - t0
         if self.metrics is not None:
             self.metrics.record_cache(hit=False)
@@ -319,7 +401,7 @@ class ImageHandler:
                 self.metrics.record_stage(stage, seconds)
         return ProcessedImage(
             content=content, spec=spec, options=options, timings=timings,
-            modified_at=modified_at,
+            modified_at=modified_at, degraded=tuple(modes),
         )
 
     # ------------------------------------------------------------------
@@ -343,6 +425,39 @@ class ImageHandler:
             data, options, spec, {} if timings is None else timings,
             deadline=deadline,
         )
+
+    def _schedule_refresh(self, spec: OutputSpec, options: OptionsBag,
+                          data: bytes) -> None:
+        """Queue ONE background re-render of a stale cache entry
+        (stale-while-revalidate, runtime/brownout.py). Coalescing is
+        two-layer: the RefreshQueue dedups per derived key (N stale hits
+        -> one queued refresh), and the refresh itself runs through the
+        single-flight table, so it also coalesces with any concurrent
+        foreground miss for the same key. The refresh renders FULL
+        quality whatever the current level — the cache must converge to
+        fresh, undegraded bytes — under the configured default deadline."""
+        engine = self.brownout
+
+        def refresh() -> None:
+            leader, _flight = self._singleflight.begin(spec.name)
+            if not leader:
+                return  # a foreground render is already computing it
+            try:
+                content = self._process_new(
+                    data, options, spec, {},
+                    deadline=Deadline(
+                        self.default_deadline_s, metrics=self.metrics
+                    ),
+                )
+                modified_at = self.storage.write(spec.name, content)
+            except BaseException as exc:
+                self._singleflight.done(spec.name, exc=exc)
+                raise
+            self._singleflight.done(
+                spec.name, result=(content, modified_at, ())
+            )
+
+        engine.refresh.submit(spec.name, refresh)
 
     # ------------------------------------------------------------------
     # deadline-aware device waits
@@ -541,12 +656,20 @@ class ImageHandler:
         *,
         alpha,
         deadline: Optional[Deadline] = None,
+        quality_cap: Optional[int] = None,
+        degraded_out: Optional[List[str]] = None,
     ) -> bytes:
         """Encode a finished frame. JPEG outputs ride the native encode
         pool through the host-codec controller when available, so
         concurrent misses pay the trellis DP in parallel on C worker
         threads (the encode-side twin of _decode_batched); everything else
-        (and every fallback) uses the single-image encode()."""
+        (and every fallback) uses the single-image encode().
+        ``quality_cap`` is the brownout clamp (docs/degradation.md): it
+        applies — and tags "quality" into ``degraded_out`` — only when it
+        actually lowers the effective quality of a LOSSY output, so the
+        tag, the never-cache decision keyed on it, and the bytes can
+        never drift apart (PNG/GIF ignore quality; lossless WebP bytes
+        must stay byte-identical to the normal render)."""
         from flyimg_tpu.codecs import (
             batch_jpeg_encode,
             native_codec,
@@ -554,6 +677,14 @@ class ImageHandler:
         )
 
         quality = options.int_option("quality", 90) or 90
+        lossy = spec.extension == "jpg" or (
+            spec.extension == "webp"
+            and not options.truthy("webp-lossless")
+        )
+        if quality_cap is not None and lossy and int(quality_cap) < quality:
+            quality = int(quality_cap)
+            if degraded_out is not None:
+                degraded_out.append("quality")
         mozjpeg = str(options.get_option("mozjpeg")) == "1"
         sampling_factor = str(options.get_option("sampling-factor") or "1x1")
         if parse_colorspace(options) == "cmyk":
@@ -644,9 +775,18 @@ class ImageHandler:
         spec: OutputSpec,
         timings: Dict[str, float],
         deadline: Optional[Deadline] = None,
+        degrade=None,
+        degraded_out: Optional[List[str]] = None,
     ) -> bytes:
         """Transform pipeline on a cache miss (reference
-        ImageHandler::processNewImage, ImageHandler.php:160-181)."""
+        ImageHandler::processNewImage, ImageHandler.php:160-181).
+
+        ``degrade`` (the brownout engine, at BROWNOUT+) rewrites the plan
+        to cheaper work — finishing ops dropped, host entropy crop in
+        place of the device smart-crop scoring pass, encode quality
+        clamped to ``brownout_quality`` — appending the applied mode
+        names to ``degraded_out`` (docs/degradation.md). None = the
+        byte-for-byte normal pipeline."""
         t = time.perf_counter()
         if deadline is not None:
             deadline.check("decode")
@@ -677,6 +817,15 @@ class ImageHandler:
 
         w, h = decoded.size
         plan = build_plan(options, w, h)
+        quality_cap = None
+        if degrade is not None:
+            plan, dropped = degrade_plan(plan)
+            if degraded_out is not None:
+                degraded_out.extend(dropped)
+            # the "quality" mode is tagged by _encode_one itself, where
+            # the clamp actually applies — the tag and the bytes cannot
+            # drift apart
+            quality_cap = int(degrade.quality)
         spec.command_repr = repr(plan)
 
         frames = [decoded.rgb]
@@ -748,9 +897,15 @@ class ImageHandler:
             staged = []
             for idx, frame in enumerate(frames):
                 fh, fw = frame.shape[:2]
-                frame_plan = plan if (fw, fh) == plan.src_size else build_plan(
-                    options, fw, fh
-                )
+                if (fw, fh) == plan.src_size:
+                    frame_plan = plan
+                else:
+                    frame_plan = build_plan(options, fw, fh)
+                    if degrade is not None:
+                        # rebuilt per-frame plans (animation frames whose
+                        # dims differ) must degrade identically to the
+                        # primary plan or frames would mix work levels
+                        frame_plan, _ = degrade_plan(frame_plan)
                 if alpha_start is not None and idx >= alpha_start:
                     from dataclasses import replace as _replace
 
@@ -790,7 +945,19 @@ class ImageHandler:
         # outputs (ImageHandler.php:125-152)
         if not spec.is_gif:
             out = out_frames[0]
-            if plan.smart_crop:
+            if plan.smart_crop and degrade is not None:
+                # BROWNOUT: the deterministic host entropy crop stands in
+                # for the batched device scoring pass — same square
+                # output contract, zero device work (docs/degradation.md)
+                t = time.perf_counter()
+                with tracing.span("smartcrop", degraded=True):
+                    from flyimg_tpu.models import smartcrop as sc_mod
+
+                    out = sc_mod.entropy_crop_image(out)
+                if degraded_out is not None:
+                    degraded_out.append("smartcrop")
+                timings["smartcrop"] = time.perf_counter() - t
+            elif plan.smart_crop:
                 t = time.perf_counter()
                 with tracing.span("smartcrop"):
                     sc = self._smartcrop()
@@ -881,7 +1048,8 @@ class ImageHandler:
             else:
                 content = self._encode_one(
                     out_frames[0], spec, options, alpha=alpha,
-                    deadline=deadline,
+                    deadline=deadline, quality_cap=quality_cap,
+                    degraded_out=degraded_out,
                 )
             # st_0: the reference preserves ALL source metadata when -strip
             # is off (ImageProcessor.php:97-99) — EXIF, ICC profile, XMP. A

@@ -32,10 +32,15 @@ import httpx
 from flyimg_tpu.codecs import MediaInfo, media_info
 from flyimg_tpu.codecs import pdf as pdf_codec
 from flyimg_tpu.codecs import video as video_codec
-from flyimg_tpu.exceptions import ReadFileException
+from flyimg_tpu.exceptions import (
+    OriginUnavailableException,
+    ReadFileException,
+)
 from flyimg_tpu.runtime import tracing
+from flyimg_tpu.runtime.brownout import NegativeCache
 from flyimg_tpu.runtime.resilience import (
     BreakerRegistry,
+    CircuitOpenException,
     Deadline,
     RetryPolicy,
     host_of,
@@ -55,6 +60,17 @@ _TRANSIENT_HTTPX = (
     httpx.WriteTimeout,
     httpx.PoolTimeout,
     httpx.RemoteProtocolError,
+)
+
+# connect-phase failures never reached the origin: negative-cache them
+# host+path-wide (any query of the path would fail identically). Every
+# other transient (read stall, 5xx, 429) got an answer FROM the origin,
+# so only the exact resource is proven bad — those entries carry a query
+# digest so one broken ?id= cannot poison its healthy siblings.
+_ORIGIN_SCOPE_HTTPX = (
+    httpx.ConnectError,
+    httpx.ConnectTimeout,
+    httpx.PoolTimeout,
 )
 
 
@@ -80,6 +96,9 @@ class FetchPolicy:
     write_timeout_s: float = 10.0
     retry: Optional[RetryPolicy] = None
     breakers: Optional[BreakerRegistry] = None
+    # TTL'd negative origin cache (runtime/brownout.py NegativeCache):
+    # None/disabled keeps today's fetch path untouched
+    negative: Optional[NegativeCache] = None
 
     def __post_init__(self) -> None:
         if self.retry is None:
@@ -103,6 +122,7 @@ class FetchPolicy:
 
     @classmethod
     def from_params(cls, params, *, metrics=None) -> "FetchPolicy":
+        negative_ttl = float(params.by_key("negative_cache_ttl_s", 0.0) or 0.0)
         return cls(
             connect_timeout_s=float(
                 params.by_key("fetch_connect_timeout_s", 3.0)
@@ -113,6 +133,17 @@ class FetchPolicy:
             ),
             retry=RetryPolicy.from_params(params, metrics=metrics),
             breakers=BreakerRegistry.from_params(params, metrics=metrics),
+            negative=(
+                NegativeCache(
+                    negative_ttl,
+                    max_entries=int(
+                        params.by_key("negative_cache_max_entries", 1024)
+                    ),
+                    metrics=metrics,
+                )
+                if negative_ttl > 0
+                else None
+            ),
         )
 
 
@@ -218,6 +249,24 @@ def fetch_original(
             )
     else:
         policy = policy if policy is not None else FetchPolicy()
+        # negative origin cache (runtime/brownout.py): a host+path that
+        # recently exhausted its retries (or whose breaker is open)
+        # short-circuits to an immediate 502 instead of re-burning
+        # connect/read timeouts. Checked AFTER the L1 original cache
+        # above: a stale local copy always beats a fast failure.
+        negative = policy.negative
+        if negative is not None:
+            cached_error = negative.hit(image_url)
+            if cached_error is not None:
+                host, _path, _digest = negative.key_for(image_url)
+                tracing.add_event(
+                    "fetch.negative_cache_hit", host=host,
+                    error=cached_error,
+                )
+                raise OriginUnavailableException(
+                    f"origin {host} is negative-cached as recently failing "
+                    f"({cached_error}); not re-fetching {image_url}"
+                )
         headers = _parse_extra_headers(header_extra_options)
         breaker = policy.breakers.for_host(host_of(image_url))
 
@@ -263,7 +312,23 @@ def fetch_original(
                 deadline=deadline,
                 point="fetch",
             )
+        except CircuitOpenException:
+            # breaker outcomes feed the negative cache: while this host
+            # sheds at the breaker, same-path fetches can skip even the
+            # breaker's bookkeeping and fail in a dict lookup (the
+            # breaker is per-host, so the entry is origin-scoped)
+            if negative is not None:
+                negative.add(image_url, "circuit_open")
+            raise
         except httpx.HTTPError as exc:
+            if negative is not None and is_transient_fetch_error(exc):
+                # retries exhausted on a transient-class failure: the
+                # origin (not this request) is the problem — remember it
+                negative.add(
+                    image_url,
+                    type(exc).__name__,
+                    resource=not isinstance(exc, _ORIGIN_SCOPE_HTTPX),
+                )
             raise ReadFileException(
                 f"Unable to fetch source image: {image_url}: {exc}"
             ) from exc

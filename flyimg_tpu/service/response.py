@@ -46,6 +46,20 @@ def image_headers(result: ProcessedImage, header_cache_days: int) -> Dict[str, s
         # without Vary a shared cache serves one client's variant to all
         headers["Vary"] = "Accept"
 
+    # brownout markers (runtime/brownout.py; docs/degradation.md): absent
+    # entirely — no new headers — unless this response was actually
+    # degraded, so the engine-off path stays byte-for-byte identical
+    degraded_modes = list(result.degraded)
+    if result.stale:
+        degraded_modes.append("stale")
+        # RFC 9111 stale marker: the bytes are a cache entry past its
+        # freshness TTL, served while a background refresh re-renders
+        headers["Warning"] = '110 - "Response is Stale"'
+    if degraded_modes:
+        headers["X-Flyimg-Degraded"] = ",".join(
+            dict.fromkeys(degraded_modes)
+        )
+
     refresh = result.options.wants_refresh()
     if refresh:
         headers["Cache-Control"] = "no-cache, private"
@@ -59,6 +73,15 @@ def image_headers(result: ProcessedImage, header_cache_days: int) -> Dict[str, s
             headers["x-flyimg-timings"] = ",".join(
                 f"{k}={v * 1000:.1f}ms" for k, v in result.timings.items()
             )
+    elif result.degraded or result.stale:
+        # brownout artifacts must not be pinned downstream for a year of
+        # max-age: plan-degraded bytes are never even stored in our own
+        # cache, and a stale serve is bytes the server itself declared
+        # expired — a CDN holding either for the long-cache period would
+        # keep serving them long after the background refresh (the whole
+        # point of SWR) produced fresh ones. One minute rides out the
+        # spike.
+        headers["Cache-Control"] = "max-age=60, public"
     else:
         long_cache = 3600 * 24 * int(header_cache_days)
         headers["Cache-Control"] = (

@@ -208,6 +208,28 @@ class TransformPlan:
         )
 
 
+def degrade_plan(plan: TransformPlan) -> Tuple[TransformPlan, Tuple[str, ...]]:
+    """Rewrite a plan to its brownout form (runtime/brownout.py;
+    docs/degradation.md): drop the sharpening conv ops — unsharp and
+    sharpen, the "refine" passes whose absence only lowers visual
+    quality — so degraded requests compile/batch under a cheaper program
+    identity. Ops with SEMANTIC weight are untouched: ``blur`` can be a
+    content mask (serving it un-blurred would expose what the caller
+    asked to obscure — a correctness change, like the face ops),
+    geometry/colorspace/rotate define the output contract, and the
+    smart/face post-pass FLAGS stay so the handler can substitute the
+    smart-crop device scoring pass with the host entropy crop itself.
+    Returns ``(rewritten_plan, modes)`` where ``modes`` names what was
+    dropped ("refine") — empty means the plan had nothing to shed and
+    the original object is returned unchanged."""
+    if plan.unsharp is None and plan.sharpen is None:
+        return plan, ()
+    return (
+        replace(plan, unsharp=None, sharpen=None),
+        ("refine",),
+    )
+
+
 def rotated_bounds(w: int, h: int, degrees: float) -> Tuple[int, int]:
     """Enclosing bounding box of a w x h image rotated by ``degrees``
     (IM RotateImage grows the canvas to the rotated bounding box; for

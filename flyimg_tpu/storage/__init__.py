@@ -28,4 +28,12 @@ def make_storage(params, metrics=None) -> "Storage":
     else:
         storage = LocalStorage(params)
     storage.retry_policy = RetryPolicy.from_params(params, metrics=metrics)
+    # hedged cache-hit reads (storage/base.py fetch_hedged): after this
+    # many ms without a primary result, one backup read fires and the
+    # winner serves — bounds the cache-hit tail when the store stalls.
+    # 0 (the default) keeps reads single-attempt and hedge-free.
+    storage.hedge_delay_s = (
+        float(params.by_key("storage_hedge_delay_ms", 0.0) or 0.0) / 1000.0
+    )
+    storage.metrics = metrics
     return storage

@@ -4,8 +4,73 @@ has/read/write/delete + public URL; reference LocalStorageProvider.php:26-48).""
 from __future__ import annotations
 
 import abc
+import queue as queue_mod
+import threading
 from dataclasses import dataclass
-from typing import Optional
+from typing import Callable, Optional
+
+
+class _DaemonPool:
+    """Reusable daemon worker threads for hedged reads.
+
+    Not a ThreadPoolExecutor: its workers are non-daemon and joined at
+    interpreter exit, so one tunnel-hung backend read would block
+    shutdown forever (the same reason the batcher drains on daemon
+    threads). Workers here are daemons that park on a shared queue and
+    exit after ``idle_timeout_s`` without work — steady-state hedged
+    traffic reuses warm threads instead of paying a thread start per
+    cache lookup, a hung read merely strands its worker (the next
+    submit spawns a fresh one), and nothing outlives the process."""
+
+    def __init__(self, idle_timeout_s: float = 30.0) -> None:
+        self.idle_timeout_s = idle_timeout_s
+        self._queue: "queue_mod.Queue" = queue_mod.Queue()
+        self._lock = threading.Lock()
+        self._idle = 0
+
+    def submit(self, fn: Callable[[], None]) -> None:
+        # the enqueue happens INSIDE the lock: paired with the worker's
+        # locked drain-before-exit below, either the worker sees this
+        # item before retiring or this submit sees idle==0 and spawns —
+        # an idle-timeout retirement can never strand a queued read
+        with self._lock:
+            spawn = self._idle == 0
+            if spawn:
+                # reserve the new worker so a concurrent submit doesn't
+                # double-spawn for the same queued item
+                self._idle += 1
+            self._queue.put(fn)
+        if spawn:
+            threading.Thread(
+                target=self._run, name="flyimg-storage-read", daemon=True
+            ).start()
+
+    def _run(self) -> None:
+        while True:
+            try:
+                fn = self._queue.get(timeout=self.idle_timeout_s)
+            except queue_mod.Empty:
+                with self._lock:
+                    # a submit may have enqueued between the timeout and
+                    # this lock while counting us idle: drain it instead
+                    # of retiring and stranding it
+                    try:
+                        fn = self._queue.get_nowait()
+                    except queue_mod.Empty:
+                        self._idle -= 1
+                        return
+            with self._lock:
+                self._idle -= 1
+            try:
+                fn()
+            finally:
+                with self._lock:
+                    self._idle += 1
+
+
+#: one process-wide pool: hedged reads are rare enough (opt-in knob) that
+#: sharing across storage instances keeps the thread count minimal
+_HEDGE_POOL = _DaemonPool()
 
 
 @dataclass(frozen=True)
@@ -23,6 +88,15 @@ class Storage(abc.ABC):
     #: hiccups (throttling, 5xx, EIO) retry with jittered backoff instead
     #: of failing the request
     retry_policy = None
+    #: hedged-read delay (seconds) armed by make_storage from the
+    #: ``storage_hedge_delay_ms`` knob; 0 disables hedging and
+    #: ``fetch_hedged`` degrades to a plain ``fetch``
+    hedge_delay_s = 0.0
+    #: ceiling on the whole hedged wait (primary + backup): a store whose
+    #: BOTH reads hang must not hold the request thread forever
+    HEDGE_WAIT_CAP_S = 30.0
+    #: optional runtime.metrics.MetricsRegistry installed by make_storage
+    metrics = None
 
     @staticmethod
     def _is_transient(exc: Exception) -> bool:
@@ -104,3 +178,90 @@ class Storage(abc.ABC):
             if self.stat(name) is None:
                 return None
             raise
+
+    # -- hedged reads (docs/degradation.md "Hedged storage reads") ---------
+
+    def _record_hedge(self, winner: str) -> None:
+        if self.metrics is None:
+            return
+        self.metrics.counter(
+            f'flyimg_storage_hedged_reads_total{{winner="{winner}"}}',
+            "Hedged cache reads by which attempt produced the result",
+        ).inc()
+
+    def fetch_hedged(self, name: str) -> Optional[tuple]:
+        """``fetch`` with tail-latency hedging: the primary read runs on
+        a daemon thread; if it produces nothing within ``hedge_delay_s``
+        ONE backup read fires (a second attempt against the same
+        backend — local disk retries the open, S3/GCS issue a fresh GET
+        that lands on a different replica) and the first result wins.
+        The loser is abandoned (daemon thread), never cancelled — object
+        reads are idempotent. With hedging off (the default) this IS
+        ``fetch``, same thread, zero overhead.
+
+        The ``storage.read_delay`` fault point fires inside each attempt
+        with ``attempt=0`` (primary) / ``attempt=1`` (backup) — a plan
+        that sleeps only for attempt 0 models the slow-primary tail this
+        exists to bound; its return value is ignored (latency-only
+        point, unlike ``storage.read``'s value injection)."""
+        from flyimg_tpu.runtime import tracing
+        from flyimg_tpu.testing import faults
+
+        delay = self.hedge_delay_s
+        if not delay or delay <= 0:
+            faults.fire("storage.read_delay", name=name, attempt=0)
+            return self.fetch(name)
+        import time as _time
+
+        results: "queue_mod.Queue" = queue_mod.Queue()
+
+        def attempt(idx: int) -> None:
+            try:
+                faults.fire("storage.read_delay", name=name, attempt=idx)
+                results.put((idx, None, self.fetch(name)))
+            except BaseException as exc:  # marshalled to the caller
+                results.put((idx, exc, None))
+
+        # reads run on the shared daemon pool (warm threads reused across
+        # lookups — no thread start on the cache-hit hot path; a hung
+        # read strands only its worker)
+        _HEDGE_POOL.submit(lambda: attempt(0))
+        outstanding = 1
+        hedged = False
+        first_error = None
+        deadline = _time.monotonic() + self.HEDGE_WAIT_CAP_S
+        timeout = delay
+        while outstanding:
+            try:
+                idx, exc, value = results.get(timeout=timeout)
+            except queue_mod.Empty:
+                if not hedged:
+                    # primary produced nothing within the hedge delay:
+                    # fire the one backup and keep waiting for whichever
+                    # lands first
+                    hedged = True
+                    outstanding += 1
+                    tracing.add_event("storage.hedge", key=name)
+                    if self.metrics is not None:
+                        self.metrics.counter(
+                            "flyimg_storage_hedges_total",
+                            "Backup reads fired after a slow primary",
+                        ).inc()
+                    _HEDGE_POOL.submit(lambda: attempt(1))
+                    timeout = max(deadline - _time.monotonic(), 0.001)
+                    continue
+                raise TimeoutError(
+                    f"hedged storage read of {name!r} produced no result "
+                    f"within {self.HEDGE_WAIT_CAP_S}s"
+                )
+            outstanding -= 1
+            if exc is None:
+                if hedged:
+                    self._record_hedge(
+                        "backup" if idx == 1 else "primary"
+                    )
+                return value
+            if first_error is None:
+                first_error = exc
+            timeout = max(deadline - _time.monotonic(), 0.001)
+        raise first_error

@@ -26,6 +26,22 @@ through:
                         and recovery launches), ctx ``key``/``n``/
                         ``batch`` — raising models a transient readback
                         failure, retried at the batch level
+    ``brownout.signal`` one brownout pressure evaluation
+                        (runtime/brownout.py BrownoutEngine.evaluate):
+                        a plan returning a float OVERRIDES the computed
+                        pressure scalar (and bypasses the evaluation
+                        rate limit), so tests script the exact
+                        escalation/de-escalation sequence
+    ``brownout.refresh`` one stale-while-revalidate background re-render
+                        about to run (ctx ``key``); the fired count is
+                        how tests assert refresh coalescing
+    ``storage.read_delay`` one hedged-read attempt starting
+                        (storage/base.py fetch_hedged), ctx ``name``/
+                        ``attempt`` (0 = primary, 1 = backup); a plan
+                        that sleeps only for attempt 0 models the
+                        slow-primary tail. Return value ignored
+                        (latency-only point — use ``storage.read`` for
+                        value injection)
 
 Production cost is one module-level ``None`` check per point (no injector
 installed -> ``fire`` returns ``PASS`` immediately). Tests install a

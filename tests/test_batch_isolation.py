@@ -485,3 +485,54 @@ def test_image_digest_stable_and_distinct():
     a, b = _img(1), _img(2)
     assert _image_digest(a) == _image_digest(a.copy())
     assert _image_digest(a) != _image_digest(b)
+
+
+# ---------------------------------------------------------------------------
+# close() regression: a batch whose dispatch is still in flight at the
+# drain snapshot must be timeout-stamped, not leave its callers hanging
+
+
+def test_close_stamps_batch_wedged_mid_dispatch():
+    """The executor wedges INSIDE _execute (before any drain thread
+    exists). The batch is registered in the in-flight set at pop time,
+    so close()'s bounded drain sees it and timeout-stamps its futures —
+    previously the drain snapshot was empty and callers blocked forever
+    on futures nobody would ever resolve."""
+    wedge = threading.Event()
+    faults.install(faults.FaultInjector()).plan(
+        "batcher.execute", faults.wedge_until(wedge)
+    )
+    ctl = _ctl(lone_flush=True)
+    try:
+        fut = ctl.submit(_img(0), _plan())
+        for _ in range(200):
+            if faults._active.fired.get("batcher.execute"):
+                break
+            time.sleep(0.02)
+        assert faults._active.fired.get("batcher.execute", 0) >= 1
+        t0 = time.monotonic()
+        ctl.close(drain_timeout_s=0.5)
+        assert time.monotonic() - t0 < 5.0  # bounded, not the join cap
+        with pytest.raises(TimeoutError, match="readback hung"):
+            fut.result(timeout=1)
+    finally:
+        wedge.set()
+
+
+def test_close_clean_batch_not_stamped():
+    """The registration must not leak: a batch that completes normally
+    deregisters, and close() after quiescence stamps nothing."""
+    ctl = _ctl(lone_flush=True)
+    img = _img(0)
+    fut = ctl.submit(img, _plan())
+    np.testing.assert_array_equal(
+        fut.result(timeout=120), run_plan(img, _plan())
+    )
+    for _ in range(200):
+        with ctl._lock:
+            if not ctl._inflight_batches:
+                break
+        time.sleep(0.02)
+    with ctl._lock:
+        assert not ctl._inflight_batches
+    ctl.close(drain_timeout_s=2.0)

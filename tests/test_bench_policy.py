@@ -46,3 +46,37 @@ def test_probe_reports_backend_name_under_pin(monkeypatch):
     ok, name = probe_selected_backend(120.0, capture_name=True)
     assert ok is True
     assert name == "cpu"
+
+
+def _load_bench_http():
+    import importlib.util
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools", "bench_http.py",
+    )
+    spec = importlib.util.spec_from_file_location("bench_http", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_http_report_all_failed_row_is_schema_complete(capsys):
+    # an all-failed rated leg is the saturation knee — the row artifact
+    # consumers care about MOST. It must carry the same schema as
+    # success rows (explicit null latency fields + saturated flag), not
+    # a truncated dict that KeyErrors every consumer (ISSUE 5 satellite)
+    mod = _load_bench_http()
+    row = mod._report("miss", "rated@500", [], 123, 10.0)
+    assert row["saturated"] is True
+    assert row["requests"] == 123
+    assert row["success_rate"] == 0.0
+    assert row["throughput_rps"] == 0.0
+    assert set(row["latency_ms"]) == {"mean", "p50", "p95", "p99", "max"}
+    assert all(v is None for v in row["latency_ms"].values())
+    out = capsys.readouterr().out
+    assert "saturated" in out
+
+    ok = mod._report("miss", "rated@10", [0.01, 0.02], 0, 1.0)
+    assert ok["saturated"] is False
+    assert ok["latency_ms"]["p99"] is not None

@@ -638,3 +638,75 @@ def test_paeth_heavy_predictor_stream_hits_scalar_ceiling():
     data = (b"\x04" + b"\x00" * rowlen) * nrows
     with pytest.raises(PdfRefusal):
         _png_unfilter(data, columns, 1)
+
+
+def test_scalar_predictor_budget_is_document_wide():
+    """N hostile Paeth streams in ONE document share a cumulative budget:
+    each stream alone fits the per-stream ceiling, but the document
+    refuses once their SUM exceeds it — the ~5 s scalar-loop bound holds
+    per document, not per stream (ISSUE 5 satellite)."""
+    from flyimg_tpu.codecs.pdf_mini import _Ref
+
+    columns, nrows = 50, 20  # 1000 scalar bytes per stream
+    raw = zlib.compress((b"\x04" + b"\x00" * columns) * nrows)
+    parms = (
+        b"/Filter /FlateDecode /DecodeParms "
+        b"<< /Predictor 15 /Columns 50 /Colors 1 >> "
+    )
+    objs = dict(_page_objs(b""))
+    del objs[5]
+    objs[5] = _stream(b"")  # page content: empty (streams read directly)
+    objs[10] = _stream(raw, parms)
+    objs[11] = _stream(raw, parms)
+    data = _pdf(objs)
+
+    # both streams fit the default (12 MB) document budget
+    doc = MiniPdf(data)
+    assert doc.decoded_stream(_Ref(10)) == b"\x00" * (columns * nrows)
+    assert doc.decoded_stream(_Ref(11)) == b"\x00" * (columns * nrows)
+
+    # with a budget one stream fits but two exceed, the SECOND stream of
+    # the SAME document refuses — the counter is cumulative
+    tight = MiniPdf(data, scalar_predictor_budget=1500)
+    assert tight.decoded_stream(_Ref(10))
+    with pytest.raises(PdfRefusal, match="cumulative"):
+        tight.decoded_stream(_Ref(11))
+
+    # a fresh document starts with a fresh budget (per-MiniPdf, not global)
+    again = MiniPdf(data, scalar_predictor_budget=1500)
+    assert again.decoded_stream(_Ref(10))
+
+
+def test_scalar_budget_scales_with_pages_bounded():
+    """Legit multi-page Paeth scans get one base budget per page (so a
+    2-page scan that decoded pre-satellite still decodes), but the
+    multiplier caps at MAX_SCALAR_BUDGET_PAGES — a hostile document
+    declaring 1000 pages cannot buy unbounded CPU."""
+    from flyimg_tpu.codecs.pdf_mini import (
+        MAX_PREDICTOR_SCALAR_BYTES,
+        MAX_SCALAR_BUDGET_PAGES,
+    )
+
+    def doc_with_pages(n):
+        kids = b" ".join(b"%d 0 R" % (10 + i) for i in range(n))
+        objs = {
+            1: b"<< /Type /Catalog /Pages 2 0 R >>",
+            2: (
+                b"<< /Type /Pages /Count %d /Kids [" % n + kids + b"] >>"
+            ),
+        }
+        for i in range(n):
+            objs[10 + i] = (
+                b"<< /Type /Page /Parent 2 0 R /MediaBox [0 0 10 10] >>"
+            )
+        return MiniPdf(_pdf(objs))
+
+    assert doc_with_pages(1)._scalar_budget_left == (
+        MAX_PREDICTOR_SCALAR_BYTES
+    )
+    assert doc_with_pages(2)._scalar_budget_left == (
+        2 * MAX_PREDICTOR_SCALAR_BYTES
+    )
+    assert doc_with_pages(50)._scalar_budget_left == (
+        MAX_SCALAR_BUDGET_PAGES * MAX_PREDICTOR_SCALAR_BYTES
+    )

@@ -153,9 +153,28 @@ async def _miss_run(
 
 def _report(name: str, mode: str, lat, failures: int, elapsed: float):
     if not lat:
-        print(f"{name:8s} {mode:6s}  ALL {failures} REQUESTS FAILED")
-        return {"scenario": name, "mode": mode, "requests": failures,
-                "success_rate": 0.0}
+        # all-failed legs are the MOST important rows of an overload
+        # sweep (they mark the saturation knee): emit the same schema as
+        # success rows — explicit null latency fields plus a
+        # "saturated" flag — so artifact consumers handle them
+        # deterministically instead of KeyError-ing on the data point
+        # that matters
+        row = {
+            "scenario": name,
+            "mode": mode,
+            "requests": failures,
+            "success_rate": 0.0,
+            "throughput_rps": 0.0,
+            "saturated": True,
+            "latency_ms": {
+                "mean": None, "p50": None, "p95": None, "p99": None,
+                "max": None,
+            },
+        }
+        print(f"{name:8s} {mode:6s}  ALL {failures} REQUESTS FAILED "
+              "(saturated)")
+        print(json.dumps(row))
+        return row
     arr = np.asarray(lat) * 1000.0
     row = {
         "scenario": name,
@@ -163,6 +182,7 @@ def _report(name: str, mode: str, lat, failures: int, elapsed: float):
         "requests": len(lat) + failures,
         "success_rate": round(len(lat) / (len(lat) + failures), 4),
         "throughput_rps": round(len(lat) / elapsed, 1),
+        "saturated": False,
         "latency_ms": {
             "mean": round(float(arr.mean()), 2),
             "p50": round(float(np.percentile(arr, 50)), 2),

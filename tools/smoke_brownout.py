@@ -1,0 +1,203 @@
+"""CI brownout smoke: boot the app with the degradation engine enabled,
+drive it through injected overload pressure, and assert the wired-together
+service degrades gracefully end to end (docs/degradation.md):
+
+- the level gauge walks NORMAL -> BROWNOUT -> NORMAL (hysteresis cycle),
+- a stale cache hit under pressure carries the degraded/stale markers
+  (X-Flyimg-Degraded + Warning: 110) while serving the cached bytes,
+- a degraded miss render is tagged and short-cached,
+- a negative-cached origin answers a fast 502 without a new fetch attempt,
+- /debug/brownout reports coherent JSON.
+
+    JAX_PLATFORMS=cpu python tools/smoke_brownout.py
+
+Exit code 0 = every assertion held. The behavioral matrix (dwell math,
+hysteresis gap, SWR coalescing counts, hedged-read tail bounds) lives in
+tests/test_brownout.py; this script exists so CI proves the assembled
+service — middleware evaluation, handler policies, response headers,
+metrics — degrades as one system, not just that the engine unit does.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+import tempfile
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def _require(cond: bool, what: str) -> None:
+    if not cond:
+        print(f"FAIL: {what}", file=sys.stderr)
+        raise SystemExit(1)
+
+
+def _metric_value(text: str, name: str) -> float:
+    for line in text.splitlines():
+        if line.startswith(name + " "):
+            try:
+                return float(line.rsplit(" ", 1)[1])
+            except ValueError:
+                continue
+    return float("nan")
+
+
+class _Clock:
+    """Injectable engine clock so the de-escalation dwell needs no
+    real waiting."""
+
+    def __init__(self) -> None:
+        self.now = 1000.0
+
+    def __call__(self) -> float:
+        return self.now
+
+
+async def main() -> int:
+    import httpx
+    import numpy as np
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from flyimg_tpu.appconfig import AppParameters
+    from flyimg_tpu.codecs import encode
+    from flyimg_tpu.service.app import make_app
+    from flyimg_tpu.testing import faults
+
+    tmp = tempfile.mkdtemp(prefix="flyimg-brownout-")
+    rng = np.random.default_rng(7)
+    src = os.path.join(tmp, "src.png")
+    with open(src, "wb") as fh:
+        fh.write(
+            encode(rng.integers(0, 255, (64, 96, 3), dtype=np.uint8), "png")
+        )
+
+    pressure = [0.0]
+    clock = _Clock()
+    injector = faults.FaultInjector()
+    injector.plan("brownout.signal", lambda **_: pressure[0])
+    injector.plan(
+        "fetch.http",
+        lambda **_: (_ for _ in ()).throw(httpx.ConnectError("origin down")),
+    )
+    upload_dir = os.path.join(tmp, "u")
+    params = AppParameters(
+        {
+            "tmp_dir": os.path.join(tmp, "t"),
+            "upload_dir": upload_dir,
+            "debug": True,
+            "brownout_enable": True,
+            "brownout_clock": clock,
+            "brownout_min_dwell_s": 5.0,
+            "brownout_stale_ttl_s": 300.0,
+            "negative_cache_ttl_s": 60.0,
+            "retry_max_attempts": 1,
+            "fault_injector": injector,
+        }
+    )
+    app = make_app(params)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        async def gauge() -> float:
+            text = await (await client.get("/metrics")).text()
+            return _metric_value(text, "flyimg_brownout_level")
+
+        url = f"/upload/w_40,o_jpg,q_90,sh_2/{src}"
+
+        # 1) NORMAL: populate the cache, no markers anywhere
+        warm = await client.get(url)
+        _require(warm.status == 200, f"warm render 200 (got {warm.status})")
+        _require(
+            "X-Flyimg-Degraded" not in warm.headers,
+            "no degraded marker under NORMAL",
+        )
+        _require(await gauge() == 0.0, "level gauge starts at 0")
+
+        # 2) age the cached output past the stale TTL
+        for name in os.listdir(upload_dir):
+            old = time.time() - 3600
+            os.utime(os.path.join(upload_dir, name), (old, old))
+
+        # 3) inject overload: NORMAL -> BROWNOUT, stale hit marked
+        pressure[0] = 0.95
+        stale = await client.get(url)
+        _require(stale.status == 200, "stale hit serves 200")
+        _require(
+            "stale" in stale.headers.get("X-Flyimg-Degraded", ""),
+            f"stale marker present (headers {dict(stale.headers)})",
+        )
+        _require(
+            stale.headers.get("Warning", "").startswith("110"),
+            "Warning: 110 on the stale response",
+        )
+        _require(await gauge() == 2.0, "level gauge escalated to 2")
+
+        # 4) a degraded MISS render is tagged and short-cached
+        miss = await client.get(f"/upload/w_41,o_jpg,q_90,sh_2/{src}")
+        _require(miss.status == 200, "degraded miss serves 200")
+        tags = miss.headers.get("X-Flyimg-Degraded", "").split(",")
+        _require(
+            "refine" in tags and "quality" in tags,
+            f"plan-rewrite tags present (got {tags})",
+        )
+        _require(
+            "max-age=60" in miss.headers.get("Cache-Control", ""),
+            "degraded render is short-cached",
+        )
+
+        # 5) negative-cached origin: first failure 404, repeat = fast 502
+        #    with no new fetch attempt
+        bad = "/upload/w_20,o_png/http://dead.example.com/img.png"
+        first = await client.get(bad)
+        _require(first.status == 404, f"first dead fetch 404 ({first.status})")
+        fired = injector.fired.get("fetch.http", 0)
+        t0 = time.perf_counter()
+        second = await client.get(bad)
+        elapsed = time.perf_counter() - t0
+        _require(second.status == 502, f"negative-cached 502 ({second.status})")
+        _require(
+            injector.fired.get("fetch.http", 0) == fired,
+            "no new fetch attempt behind the negative cache",
+        )
+        _require(elapsed < 1.0, f"negative-cache rejection fast ({elapsed:.3f}s)")
+
+        # 6) pressure drops: dwell holds, then one level per elapsed
+        #    dwell window
+        pressure[0] = 0.0
+        await client.get(url)
+        _require(await gauge() == 2.0, "dwell holds the level")
+        clock.now += 6.0
+        await client.get(url)
+        _require(await gauge() == 1.0, "first de-escalation step")
+        clock.now += 6.0
+        await client.get(url)
+        _require(await gauge() == 0.0, "back to NORMAL")
+
+        # 7) /debug/brownout coherent
+        import json as _json
+
+        snap = _json.loads(
+            await (await client.get("/debug/brownout")).text()
+        )
+        _require(snap["enabled"] is True, "snapshot enabled")
+        _require(snap["level_name"] == "normal", "snapshot level normal")
+        _require(
+            snap["transitions_total"] >= 3,
+            f"transitions recorded ({snap['transitions_total']})",
+        )
+        print(
+            "brownout smoke OK: NORMAL->BROWNOUT->NORMAL, stale + degraded "
+            "markers served, negative-cached origin 502 in "
+            f"{elapsed * 1000:.0f} ms"
+        )
+        return 0
+    finally:
+        await client.close()
+
+
+if __name__ == "__main__":
+    raise SystemExit(asyncio.run(main()))
