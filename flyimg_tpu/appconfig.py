@@ -30,6 +30,11 @@ SERVER_DEFAULTS: Dict[str, Any] = {
     "whitelist_domains": [],
     "storage_system": "local",
     "aws_s3": {"access_id": "", "secret_key": "", "region": "", "bucket_name": ""},
+    # GCS storage backend config (storage/gcs.py): bucket_name +
+    # optional project; credentials come from ADC
+    "gcs": {"bucket_name": "", "project": ""},
+    # route-pattern overrides (service/app.py; reference config/routes.yml)
+    "routes": {},
     "header_extra_options": (
         "User-Agent: Mozilla/5.0 (Windows; U; Windows NT 6.1; rv:2.2) "
         "Gecko/20110201"
@@ -44,7 +49,22 @@ SERVER_DEFAULTS: Dict[str, Any] = {
     # dispatched-but-unread batches in flight (2 = double buffering;
     # 1 = strict serial launch->read). See runtime/batcher.py.
     "batch_pipeline_depth": 2,
-    "device_mesh": "auto",
+    # host-codec batch controller (native DecodePool JPEG-miss decode)
+    "decode_batch_max": 32,
+    "decode_deadline_ms": 1.0,
+    # face engine selection + optional blazeface checkpoint dir
+    # (models/faces.py make_face_backend)
+    "face_backend": "auto",
+    "face_checkpoint": None,
+    # persistent XLA compilation cache dir ('' disables; service/app.py)
+    "compilation_cache_dir": "var/cache/xla",
+    # boot-time accelerator compute probe deadline (parallel/mesh.py
+    # ensure_live_backend; 0 trusts the selection and may hang)
+    "backend_probe_timeout_s": 75.0,
+    # local-storage output-cache size budget + background prune cadence
+    # (0 disables the budget; non-positive interval disables the loop)
+    "cache_max_bytes": 0,
+    "cache_prune_interval_s": 300.0,
     # --- resilience knobs (runtime/resilience.py; docs/architecture.md
     # "Resilience") ---
     # per-request latency budget, minted at HTTP ingress and consumed by
@@ -184,6 +204,12 @@ SERVER_DEFAULTS: Dict[str, Any] = {
     # fired and the winner served (bounds cache-hit tail latency when
     # the backing store stalls); 0 disables hedging
     "storage_hedge_delay_ms": 0.0,
+    # --- object-passing test hooks (never set in YAML) ---
+    # a testing.faults.FaultInjector installed at app construction
+    "fault_injector": None,
+    # injectable monotonic clock for the brownout hysteresis engine
+    # (runtime/brownout.py from_params) so dwell tests never sleep
+    "brownout_clock": None,
 }
 
 

@@ -443,6 +443,9 @@ def train_synthetic(
     params = init_params(jax.random.PRNGKey(seed))
     optimizer, train_step = make_train_step()
     opt_state = optimizer.init(params)
+    # accepted uncached jit (flylint baseline): ONE jitted step per
+    # training run (offline tooling, not the serving path) — the compile
+    # amortizes over every step of the loop below
     step_fn = jax.jit(train_step, donate_argnums=(0, 1))
     loss = float("nan")  # steps=0: params back unchanged, loss undefined
     for step in range(steps):

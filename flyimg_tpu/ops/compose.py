@@ -25,13 +25,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from flyimg_tpu.ops.color import monochrome_dither, to_grayscale
+from flyimg_tpu.ops.filters import gaussian_blur, sharpen as sharpen_op, unsharp_mask
+from flyimg_tpu.ops.pad import extent_pad
+from flyimg_tpu.ops.resample import resample_image
+from flyimg_tpu.ops.rotate import rotate_image, rotate_image_dynamic
 from flyimg_tpu.spec.geometry import gravity_offset
 from flyimg_tpu.spec.plan import TransformPlan
-from flyimg_tpu.ops.resample import resample_image
-from flyimg_tpu.ops.filters import gaussian_blur, sharpen as sharpen_op, unsharp_mask
-from flyimg_tpu.ops.color import monochrome_dither, to_grayscale
-from flyimg_tpu.ops.rotate import rotate_image, rotate_image_dynamic
-from flyimg_tpu.ops.pad import extent_pad
 
 
 @dataclass(frozen=True)

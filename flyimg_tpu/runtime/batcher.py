@@ -279,16 +279,27 @@ class BatchController:
         self._pipeline_depth = max(1, int(pipeline_depth))
         self._inflight = threading.Semaphore(self._pipeline_depth)
         self._inflight_batches: List[List[_Pending]] = []
-        self._spawn_executor()
+        # True between installing a replacement executor (under the lock)
+        # and its first scheduling in _run: an installed-but-unstarted
+        # thread is not alive, and without this flag a concurrent
+        # submitter would mis-read it as dead and heal AGAIN
+        self._executor_pending = False
+        self._spawn_executor().start()
 
-    def _spawn_executor(self) -> None:
-        """Start (or, from self-healing, replace) THE executor thread.
-        ``self._thread`` identity doubles as the supersession marker:
-        a replaced thread notices ``self._thread is not me`` and exits."""
+    def _spawn_executor(self) -> threading.Thread:
+        """Install (or, from self-healing, replace) THE executor thread
+        and return it UNSTARTED — callers start it outside the lock
+        (``Thread.start`` blocks on the new OS thread coming up;
+        flylint: lock-held-blocking-call). ``self._thread`` identity
+        doubles as the supersession marker: a replaced thread notices
+        ``self._thread is not me`` and exits; the not-yet-started
+        replacement is safe to install under the lock because its first
+        action in ``_run`` is to take the lock itself."""
         self._thread = threading.Thread(
             target=self._run, name="flyimg-batcher", daemon=True
         )
-        self._thread.start()
+        self._executor_pending = True
+        return self._thread
 
     # ------------------------------------------------------------------
 
@@ -459,11 +470,12 @@ class BatchController:
         pending.future.add_done_callback(
             lambda _f: self.admission.release()
         )
+        replacement = None
         try:
             with self._lock:
                 if self._stop:
                     raise RuntimeError("batcher is closed")
-                self._maybe_heal_executor_locked()
+                replacement = self._maybe_heal_executor_locked()
                 group = self._groups.get(key)
                 if group is None:
                     group = make_group()
@@ -474,8 +486,23 @@ class BatchController:
             if not pending.future.done():
                 self.admission.release()
             raise
+        finally:
+            # start the healed executor OUTSIDE the lock (thread start
+            # blocks on OS scheduling; under the lock it would convoy
+            # every concurrent submitter) — and in a finally so an
+            # enqueue failure can never strand an installed-but-unstarted
+            # executor: queued groups would wait forever
+            if replacement is not None:
+                try:
+                    replacement.start()
+                except BaseException:
+                    # spawn failure: clear the pending marker so the next
+                    # submission can attempt healing again
+                    with self._lock:
+                        self._executor_pending = False
+                    raise
 
-    def _maybe_heal_executor_locked(self) -> None:
+    def _maybe_heal_executor_locked(self) -> Optional[threading.Thread]:
         """Executor self-healing, checked at every submission (caller
         holds the lock): a DEAD executor thread (killed by a
         BaseException escaping a batch) is always replaced; a WEDGED one
@@ -486,9 +513,14 @@ class BatchController:
         submissions stop stranding behind the per-request CPU fallback.
         The superseded thread, if it ever unwedges, sees
         ``self._thread is not me`` and exits; its in-flight futures
-        resolve normally (every resolution is done()-guarded)."""
-        if self._stop:
-            return
+        resolve normally (every resolution is done()-guarded).
+
+        Returns the replacement thread UNSTARTED (None when no healing
+        happened): the caller must ``start()`` it after releasing the
+        lock — starting a thread blocks, and blocking under this lock
+        convoys every submitter (flylint lock-held-blocking-call)."""
+        if self._stop or self._executor_pending:
+            return None
         reason = None
         if not self._thread.is_alive():
             reason = "dead"
@@ -500,7 +532,7 @@ class BatchController:
         ):
             reason = "wedged"
         if reason is None:
-            return
+            return None
         self.metrics.record_executor_restart(reason)
         tracing.add_event(
             "executor_restart", reason=reason, controller=self.name
@@ -517,7 +549,7 @@ class BatchController:
             self._inflight = threading.Semaphore(self._pipeline_depth)
         self._busy_since = None
         self._busy_owner = None
-        self._spawn_executor()
+        return self._spawn_executor()
 
     def _touch_busy(self) -> None:
         """Refresh the wedge-detection progress clock. The wedge timeout
@@ -581,7 +613,12 @@ class BatchController:
             self._lock.notify_all()
         # a wedged executor cannot be joined; don't let the join spend
         # more than the caller's whole drain budget waiting for it
-        self._thread.join(timeout=min(5.0, max(drain_timeout_s, 0.1)))
+        try:
+            self._thread.join(timeout=min(5.0, max(drain_timeout_s, 0.1)))
+        except RuntimeError:
+            # installed-but-not-yet-started replacement (heal race with
+            # close): nothing to join, _run exits on the stop flag
+            pass
         # BOUNDED drain: resolve every in-flight readback before the
         # controller dies — callers (serving shutdown, bulk sweeps) still
         # hold those futures — but a tunnel-hung read must not wedge
@@ -614,6 +651,9 @@ class BatchController:
 
     def _run(self) -> None:
         me = threading.current_thread()
+        with self._lock:
+            if self._thread is me:
+                self._executor_pending = False
         while True:
             group = None
             with self._lock:

@@ -601,10 +601,15 @@ class RefreshQueue:
         self._keys: set = set()
         self._queue: "queue_mod.Queue" = queue_mod.Queue()
         self._thread: Optional[threading.Thread] = None
+        # True between deciding to spawn the worker (under the lock) and
+        # the spawn completing outside it, so a concurrent submit in that
+        # window cannot double-spawn
+        self._spawning = False
 
     def submit(self, key: str, fn: Callable[[], None]) -> bool:
         """Enqueue one refresh; False when coalesced away or dropped by
         the bound."""
+        spawn = False
         with self._lock:
             if key in self._keys:
                 return False  # already queued or refreshing: coalesced
@@ -616,12 +621,25 @@ class RefreshQueue:
                     ).inc()
                 return False
             self._keys.add(key)
-            if self._thread is None or not self._thread.is_alive():
-                self._thread = threading.Thread(
-                    target=self._run, name="flyimg-swr-refresh", daemon=True
-                )
-                self._thread.start()
+            if not self._spawning and (
+                self._thread is None or not self._thread.is_alive()
+            ):
+                self._spawning = spawn = True
         self._queue.put((key, fn))
+        if spawn:
+            # the worker starts OUTSIDE the lock: Thread.start blocks on
+            # OS scheduling, and holding the lock across it would convoy
+            # every stale-serving request thread submitting a refresh
+            # (flylint: lock-held-blocking-call)
+            thread = threading.Thread(
+                target=self._run, name="flyimg-swr-refresh", daemon=True
+            )
+            try:
+                thread.start()
+            finally:
+                with self._lock:
+                    self._thread = thread
+                    self._spawning = False
         return True
 
     def _run(self) -> None:

@@ -41,6 +41,7 @@ from flyimg_tpu.exceptions import (
     DeadlineExceededException,
     ExecFailedException,
     InvalidArgumentException,
+    MissingParamsException,
     OriginUnavailableException,
     ReadFileException,
     SecurityException,
@@ -84,6 +85,11 @@ _ERROR_STATUS = {
     OriginUnavailableException: 502,
     ServiceUnavailableException: 503,
     ExecFailedException: 500,
+    # server-side misconfiguration surfacing per-request (e.g. a signed
+    # URL arriving with no security_key configured): our fault, 500 —
+    # mapped EXPLICITLY so flylint's exception-unmapped rule can prove
+    # every exceptions.py class has a deliberate status
+    MissingParamsException: 500,
 }
 
 HOMEPAGE = """<!doctype html>
@@ -553,17 +559,20 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
 
     async def healthz(_request: web.Request) -> web.Response:
         """Liveness + device visibility (the reference's analog is 'is
-        nginx/php-fpm up'; here the chip is part of the health surface)."""
+        nginx/php-fpm up'; here the chip is part of the health surface).
+        Carries `application_name` so fleet probes can tell which
+        deployment answered."""
         import json as _json
 
+        app_name = str(params.by_key("application_name", "flyimg-tpu"))
         try:
             import jax
 
             devices = [f"{d.platform}:{d.id}" for d in jax.devices()]
-            body = {"status": "ok", "devices": devices}
+            body = {"status": "ok", "app": app_name, "devices": devices}
             status = 200
         except Exception as exc:  # device runtime down
-            body = {"status": "error", "error": str(exc)}
+            body = {"status": "error", "app": app_name, "error": str(exc)}
             status = 503
         return web.Response(
             text=_json.dumps(body), status=status,

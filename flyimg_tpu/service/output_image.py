@@ -8,7 +8,6 @@ page/time suffixes + extension) and the o_auto/o_input negotiation rules.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional
 
 from flyimg_tpu.codecs.sniff import (
     GIF_MIME,

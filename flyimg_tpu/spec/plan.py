@@ -45,7 +45,7 @@ FILTER_METHODS = {
     "gaussian": "gaussian",  # true taps (ops/resample.py _kernel_fn)
 }
 
-def parse_colorspace(options: "OptionsBag"):
+def parse_colorspace(options: "OptionsBag") -> Optional[str]:
     """THE clsp_ parser (build_plan and the handler's container check
     both consume it — two copies would drift). Normalizes IM's spelling
     variants (LinearGray / linear-gray / Linear Gray all name one

@@ -32,14 +32,17 @@ class _DaemonPool:
         # the enqueue happens INSIDE the lock: paired with the worker's
         # locked drain-before-exit below, either the worker sees this
         # item before retiring or this submit sees idle==0 and spawns —
-        # an idle-timeout retirement can never strand a queued read
+        # an idle-timeout retirement can never strand a queued read.
+        # Accepted lock-held queue op: the queue is UNBOUNDED, so put()
+        # cannot block — moving it outside the lock would reopen the
+        # retire/strand race this ordering exists to close.
         with self._lock:
             spawn = self._idle == 0
             if spawn:
                 # reserve the new worker so a concurrent submit doesn't
                 # double-spawn for the same queued item
                 self._idle += 1
-            self._queue.put(fn)
+            self._queue.put(fn)  # flylint: disable=lock-held-blocking-call
         if spawn:
             threading.Thread(
                 target=self._run, name="flyimg-storage-read", daemon=True

@@ -62,6 +62,7 @@ from typing import Callable, Dict, Optional
 
 __all__ = [
     "PASS",
+    "KNOWN_POINTS",
     "FaultInjector",
     "install",
     "clear",
@@ -71,6 +72,23 @@ __all__ = [
     "wedge_until",
     "poison_member",
 ]
+
+#: THE machine-checked registry of injection points (one entry per point
+#: documented above). flylint's fault-point rules keep this and the
+#: pipeline's ``fire`` call sites in lockstep, both directions: firing an
+#: undeclared point and declaring a never-fired point are both findings
+#: (docs/static-analysis.md).
+KNOWN_POINTS = frozenset({
+    "fetch.http",
+    "storage.read",
+    "storage.write",
+    "storage.read_delay",
+    "batcher.execute",
+    "batcher.member",
+    "batcher.drain",
+    "brownout.signal",
+    "brownout.refresh",
+})
 
 #: sentinel: "no plan fired — run the real code path"
 PASS = object()

@@ -3,9 +3,25 @@ so sharding tests run anywhere (the standard fake-mesh trick; see SURVEY.md
 section 4). The order-sensitive recipe lives in one place —
 ``flyimg_tpu.parallel.mesh.force_cpu_platform`` — shared with the driver
 contract (``__graft_entry__.dryrun_multichip``) and the bench fallback.
+
+Opt-in lock-order witness (docs/static-analysis.md "Lock-order witness"):
+``FLYIMG_LOCK_WITNESS=1`` arms ``tools.flylint.witness`` BEFORE any
+flyimg_tpu import below constructs a lock, builds the global lock-order
+graph across the whole run, and fails the session (exit status 3) when
+the graph contains a cycle — a latent AB/BA deadlock, reported with both
+acquisition stacks even if no test ever actually hung.
 """
 
-from flyimg_tpu.parallel.mesh import force_cpu_platform
+import os as _os
+import sys as _sys
+
+_LOCK_WITNESS = _os.environ.get("FLYIMG_LOCK_WITNESS") == "1"
+if _LOCK_WITNESS:
+    from tools.flylint.witness import install as _witness_install
+
+    _witness_install()
+
+from flyimg_tpu.parallel.mesh import force_cpu_platform  # noqa: E402
 
 force_cpu_platform(8)
 
@@ -13,3 +29,25 @@ import jax  # noqa: E402
 
 assert jax.devices()[0].platform == "cpu", jax.devices()
 assert len(jax.devices()) == 8, jax.devices()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Lock-order witness verdict for the WHOLE session: a cycle in the
+    global acquisition-order graph fails the run with its own exit
+    status, after the report (both stacks per edge) lands on stderr."""
+    if not _LOCK_WITNESS:
+        return
+    from tools.flylint.witness import installed_witness, session_report
+
+    report = session_report()
+    witness = installed_witness()
+    if witness is not None:
+        print(
+            f"\nflylint lock-order witness: {witness.tracked_locks} "
+            f"tracked lock(s), {witness.edge_count()} order edge(s), "
+            f"cycle={'YES' if report else 'no'}",
+            file=_sys.stderr,
+        )
+    if report:
+        print(report, file=_sys.stderr)
+        session.exitstatus = 3

@@ -7,9 +7,9 @@ import numpy as np
 import pytest
 from PIL import Image
 
+from flyimg_tpu.ops.compose import run_plan
 from flyimg_tpu.spec.options import OptionsBag
 from flyimg_tpu.spec.plan import build_plan
-from flyimg_tpu.ops.compose import run_plan
 
 from test_geometry import ALL_CASES
 

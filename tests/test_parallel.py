@@ -11,8 +11,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.sharding import NamedSharding, PartitionSpec as P
-
 from flyimg_tpu.ops.resample import resample_image
 from flyimg_tpu.parallel.mesh import batch_sharding, make_mesh, replicated
 from flyimg_tpu.parallel.tiling import tiled_transform

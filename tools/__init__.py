@@ -1,0 +1,3 @@
+"""Operator/CI tooling. Most scripts here are standalone (run as
+``python tools/<name>.py``); ``tools.flylint`` is a package invoked as
+``python -m tools.flylint`` (docs/static-analysis.md)."""

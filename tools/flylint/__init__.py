@@ -1,0 +1,35 @@
+"""flylint: project-native static analysis for the flyimg-tpu codebase.
+
+The runtime layer is lock-heavy, thread-pooled code in front of a device,
+and the project carries four cross-artifact registries (appconfig knobs,
+fault points, metric names, exception->HTTP mappings) that generic linters
+cannot see. flylint machine-checks exactly those project invariants
+(docs/static-analysis.md):
+
+- ``checkers.concurrency``   blocking calls while a lock is held,
+                             double-acquire of the same lock
+- ``checkers.registry``      knob/doc, fault-point, metric-name, and
+                             exception-mapping drift across artifacts
+- ``checkers.jax_hazards``   retrace/recompile and host-sync hazards in
+                             the device-code packages (ops/models/parallel)
+- ``checkers.observability`` span lifecycle hygiene
+
+plus one *runtime* analysis: ``witness`` — a lock-order witness that
+instruments lock acquisition during the test run, builds the global
+lock-order graph, and fails the session on a cycle (TSan-style, both
+acquisition stacks reported).
+
+Usage::
+
+    python -m tools.flylint --check          # CI gate (baseline-aware)
+    python -m tools.flylint --json           # machine-readable findings
+    FLYIMG_LOCK_WITNESS=1 python -m pytest   # runtime lock-order witness
+
+Findings are suppressed inline with ``# flylint: disable=<rule>`` (same
+line or the line above) or accepted wholesale in the committed baseline
+(``tools/flylint/baseline.json``) with a written justification.
+"""
+
+from tools.flylint.core import Finding, Project, load_baseline, run_checkers
+
+__all__ = ["Finding", "Project", "load_baseline", "run_checkers"]

@@ -1,0 +1,137 @@
+"""CLI: ``python -m tools.flylint`` (docs/static-analysis.md).
+
+Exit codes: 0 = clean (every finding suppressed or baselined),
+1 = new findings, 2 = usage/config error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+from tools.flylint.checkers import ALL_CHECKERS, ALL_RULES
+from tools.flylint.core import (
+    Project,
+    load_baseline,
+    run_checkers,
+    write_baseline,
+)
+
+DEFAULT_PATHS = ["flyimg_tpu", "tools"]
+DEFAULT_BASELINE = os.path.join("tools", "flylint", "baseline.json")
+
+
+def main(argv: List[str] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.flylint",
+        description=(
+            "Project-native static analysis: concurrency, registry "
+            "consistency, JAX hazards, observability hygiene."
+        ),
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=None,
+        help="files/directories to scan (default: flyimg_tpu)",
+    )
+    parser.add_argument(
+        "--root", default=".",
+        help="project root (appconfig/docs resolve relative to this)",
+    )
+    parser.add_argument(
+        "--check", action="store_true",
+        help="CI mode: identical to the default run, named for intent",
+    )
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable findings on stdout")
+    parser.add_argument(
+        "--baseline", default=None,
+        help=f"baseline file (default: <root>/{DEFAULT_BASELINE})",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline (report every finding)",
+    )
+    parser.add_argument(
+        "--update-baseline", action="store_true",
+        help=(
+            "accept the current findings as the new baseline (preserves "
+            "justifications for surviving entries); every new entry still "
+            "needs a justification written by hand"
+        ),
+    )
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in sorted(ALL_RULES):
+            print(f"{rule}: {ALL_RULES[rule]}")
+        return 0
+
+    root = os.path.abspath(args.root)
+    if not os.path.isdir(root):
+        print(f"flylint: no such root: {root}", file=sys.stderr)
+        return 2
+    paths = args.paths or DEFAULT_PATHS
+    baseline_path = args.baseline or os.path.join(root, DEFAULT_BASELINE)
+    baseline = {} if args.no_baseline else load_baseline(baseline_path)
+
+    project = Project(root, paths)
+    if not project.files:
+        print(
+            f"flylint: nothing to scan under {root} for {paths}",
+            file=sys.stderr,
+        )
+        return 2
+    result = run_checkers(project, ALL_CHECKERS, baseline)
+
+    if args.update_baseline:
+        write_baseline(baseline_path, result.findings, baseline)
+        print(
+            f"flylint: baseline updated with {len(result.findings)} "
+            f"finding(s) -> {baseline_path}"
+        )
+        missing = [
+            f for f in result.findings
+            if not baseline.get(f.fingerprint(), {}).get("justification")
+        ]
+        if missing:
+            print(
+                f"flylint: {len(missing)} entr(ies) need a written "
+                "justification before commit:"
+            )
+            for f in missing:
+                print(f"  {f.format()}")
+        return 0
+
+    if args.json:
+        print(json.dumps({
+            "findings": [f.as_dict() for f in result.new],
+            "baselined": [f.as_dict() for f in result.baselined],
+            "suppressed": result.suppressed,
+            "stale_baseline": result.stale_baseline,
+            "files_scanned": len(project.files),
+        }, indent=2))
+    else:
+        for f in result.new:
+            print(f.format())
+        summary = (
+            f"flylint: {len(project.files)} file(s), "
+            f"{len(result.new)} new finding(s), "
+            f"{len(result.baselined)} baselined, "
+            f"{result.suppressed} suppressed"
+        )
+        if result.stale_baseline:
+            summary += (
+                f", {len(result.stale_baseline)} stale baseline entr(ies) "
+                "(fixed or moved — run --update-baseline)"
+            )
+        print(summary)
+
+    return 1 if result.new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,28 @@
+"""Checker registry: ``python -m tools.flylint`` runs ALL_CHECKERS.
+
+Adding a checker (docs/static-analysis.md "Adding a checker"): write a
+class with ``name``, ``rules`` (rule id -> description) and
+``run(project) -> Iterable[Finding]``, then append an instance here and
+add fixture tests in tests/test_flylint.py (a positive trip, a negative
+pass, and a suppression case per rule).
+"""
+
+from tools.flylint.checkers.concurrency import ConcurrencyChecker
+from tools.flylint.checkers.jax_hazards import JaxHazardsChecker
+from tools.flylint.checkers.observability import ObservabilityChecker
+from tools.flylint.checkers.registry import RegistryChecker
+
+ALL_CHECKERS = (
+    ConcurrencyChecker(),
+    RegistryChecker(),
+    JaxHazardsChecker(),
+    ObservabilityChecker(),
+)
+
+ALL_RULES = {
+    rule: desc
+    for checker in ALL_CHECKERS
+    for rule, desc in checker.rules.items()
+}
+
+__all__ = ["ALL_CHECKERS", "ALL_RULES"]

@@ -1,0 +1,302 @@
+"""Concurrency checkers: blocking work while a lock is held, and
+double-acquire of the same lock.
+
+The serving runtime holds its locks for dict-op-sized critical sections
+by design (runtime/batcher.py, runtime/metrics.py docstrings). A blocking
+call inside one of those sections — a no-timeout ``Future.result``/
+``Queue.get``, ``Thread.join``, ``time.sleep``, a thread start, network
+or storage I/O — turns every contending request thread into a convoy (and
+is one half of a classic deadlock). This checker flags them lexically:
+
+- ``with <lock>:`` bodies (any with-item whose expression's last segment
+  contains "lock", e.g. ``self._lock``, ``trace_lock``), plus
+- bodies of methods named ``*_locked`` — the project convention for
+  "caller holds the lock" (runtime/batcher.py, runtime/resilience.py),
+- one intra-class hop: a call to ``self.<m>()`` under a held lock where
+  method ``m`` of the same class contains a blocking call is reported at
+  the call site (this is how holding the batcher lock across a
+  ``Thread.start`` hiding inside ``_spawn_executor`` was found).
+
+``Condition.wait`` on the *held* lock is exempt (it releases the lock);
+``.get``/``.join``/``.result`` with a timeout are exempt (bounded waits
+are the documented pattern here).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.flylint.core import Finding, Project
+
+RULE_BLOCKING = "lock-held-blocking-call"
+RULE_DOUBLE = "lock-double-acquire"
+
+# attribute-call receivers/names treated as I/O no matter the arguments
+_IO_CALL_NAMES = {
+    "fetch", "fetch_hedged", "fetch_original", "urlopen", "recv",
+    "sendall", "connect",
+}
+_IO_PREFIXES = (
+    "requests.", "httpx.", "urllib.request.", "socket.", "subprocess.",
+)
+
+
+def _unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - defensive
+        return "<expr>"
+
+
+def is_lock_expr(expr: ast.AST) -> bool:
+    """Heuristic: the with-item names a lock (``self._lock``,
+    ``trace_lock``, ``lock``). Matching on the LAST segment keeps
+    ``self.stock`` or ``unlock_codec()`` out."""
+    if isinstance(expr, ast.Attribute):
+        name = expr.attr
+    elif isinstance(expr, ast.Name):
+        name = expr.id
+    else:
+        return False
+    return "lock" in name.lower()
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    if any(kw.arg == "timeout" for kw in call.keywords):
+        return True
+    return False
+
+
+def _kw_is_false(call: ast.Call, name: str) -> bool:
+    for kw in call.keywords:
+        if kw.arg == name and isinstance(kw.value, ast.Constant):
+            return kw.value.value is False
+    return False
+
+
+def classify_blocking(call: ast.Call,
+                      held: Set[str]) -> Optional[str]:
+    """Why this call blocks (human label), or None. ``held`` is the set
+    of currently-held lock expressions (unparsed), used to exempt
+    ``<held lock>.wait()`` — Condition.wait releases the lock."""
+    func = call.func
+    text = _unparse(func)
+    if text in ("time.sleep", "sleep") or text.endswith(".sleep"):
+        return "sleeps"
+    if any(text.startswith(p) for p in _IO_PREFIXES):
+        return "performs network/process I/O"
+    if not isinstance(func, ast.Attribute):
+        return None
+    name = func.attr
+    recv = _unparse(func.value)
+    if name in _IO_CALL_NAMES:
+        return "performs fetch/storage I/O"
+    if name == "result" and not call.args and not _has_timeout(call):
+        return "waits on a Future without a timeout"
+    if name == "get" and not call.args and not _has_timeout(call):
+        # zero-positional .get() is the queue signature (dict.get takes
+        # a key); block=False makes it non-blocking
+        if not _kw_is_false(call, "block"):
+            return "waits on a queue without a timeout"
+    if name == "put" and not _has_timeout(call):
+        if not _kw_is_false(call, "block") and len(call.args) <= 1:
+            return "may block on a bounded queue"
+    if name == "join" and not call.args and not _has_timeout(call):
+        if not isinstance(func.value, ast.Constant):
+            return "joins a thread without a timeout"
+    if name == "wait" and not call.args and not _has_timeout(call):
+        if recv not in held:
+            return "waits on an event/condition without a timeout"
+    if name == "start" and not call.args and "thread" in recv.lower():
+        return "starts a thread"
+    return None
+
+
+class _FunctionScan(ast.NodeVisitor):
+    """Scan one function body; ``held`` lock exprs tracked lexically
+    through nested ``with`` statements. Does not descend into nested
+    function definitions (their bodies run later, lock state unknown)."""
+
+    def __init__(self, src, symbol: str,
+                 initial_held: Tuple[str, ...] = (),
+                 class_blockers: Optional[Dict[str, Tuple[str, int]]] = None,
+                 ) -> None:
+        self.src = src
+        self.symbol = symbol
+        self.held: List[str] = list(initial_held)
+        self.class_blockers = class_blockers or {}
+        self.findings: List[Finding] = []
+
+    # -- lock tracking ----------------------------------------------------
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired: List[str] = []
+        for item in node.items:
+            expr = item.context_expr
+            if is_lock_expr(expr):
+                text = _unparse(expr)
+                if text in self.held:
+                    self.findings.append(Finding(
+                        rule=RULE_DOUBLE,
+                        path=self.src.relpath,
+                        line=node.lineno,
+                        symbol=self.symbol,
+                        message=(
+                            f"`with {text}` while `{text}` is already "
+                            "held (self-deadlock on a Lock, silent "
+                            "reentrancy on an RLock)"
+                        ),
+                    ))
+                acquired.append(text)
+        self.held.extend(acquired)
+        for child in node.body:
+            self.visit(child)
+        for _ in acquired:
+            self.held.pop()
+
+    visit_AsyncWith = visit_With  # same lexical treatment
+
+    # -- blocking calls ---------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.held:
+            reason = classify_blocking(node, set(self.held))
+            if reason is not None:
+                self.findings.append(Finding(
+                    rule=RULE_BLOCKING,
+                    path=self.src.relpath,
+                    line=node.lineno,
+                    symbol=self.symbol,
+                    message=(
+                        f"`{_unparse(node.func)}(...)` {reason} while "
+                        f"`{self.held[-1]}` is held"
+                    ),
+                ))
+            else:
+                hop = self._intra_class_hop(node)
+                if hop is not None:
+                    self.findings.append(hop)
+            # explicit re-acquire of a held lock
+            func = node.func
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr == "acquire"
+                and _unparse(func.value) in self.held
+            ):
+                self.findings.append(Finding(
+                    rule=RULE_DOUBLE,
+                    path=self.src.relpath,
+                    line=node.lineno,
+                    symbol=self.symbol,
+                    message=(
+                        f"`{_unparse(func.value)}.acquire()` while it is "
+                        "already held"
+                    ),
+                ))
+        self.generic_visit(node)
+
+    def _intra_class_hop(self, node: ast.Call) -> Optional[Finding]:
+        """One-hop interprocedural check: ``self.m()`` where method ``m``
+        of the same class contains a blocking call."""
+        func = node.func
+        if not (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "self"
+        ):
+            return None
+        blocked = self.class_blockers.get(func.attr)
+        if blocked is None:
+            return None
+        # the callee's line number stays OUT of the message: messages
+        # feed the baseline fingerprint, which must survive unrelated
+        # line churn (core.py "Finding identity")
+        what, _line = blocked
+        return Finding(
+            rule=RULE_BLOCKING,
+            path=self.src.relpath,
+            line=node.lineno,
+            symbol=self.symbol,
+            message=(
+                f"`self.{func.attr}()` {what} while "
+                f"`{self.held[-1]}` is held"
+            ),
+        )
+
+    # -- do not descend into deferred bodies ------------------------------
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        return
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        return
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        return
+
+
+def _function_blocking_summary(fn: ast.AST) -> Optional[Tuple[str, int]]:
+    """Does this function body (lock-free view) contain a blocking call?
+    Used to build the per-class one-hop table."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            reason = classify_blocking(node, set())
+            if reason is not None:
+                return reason, node.lineno
+    return None
+
+
+class ConcurrencyChecker:
+    name = "concurrency"
+    rules = {
+        RULE_BLOCKING: (
+            "a blocking call (no-timeout result/get/join/wait, sleep, "
+            "thread start, fetch/storage I/O) is made while a lock is held"
+        ),
+        RULE_DOUBLE: "the same lock attribute is acquired twice lexically",
+    }
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        for src in project.files:
+            if src.tree is None:
+                continue
+            yield from self._check_file(src)
+
+    def _check_file(self, src) -> Iterable[Finding]:
+        # async functions are deliberately out of scope: holding an
+        # asyncio lock across an await is normal cooperative scheduling,
+        # not a thread convoy (docs/static-analysis.md)
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef):
+                # class -> method -> (reason, line), for the one-hop rule
+                blockers: Dict[str, Tuple[str, int]] = {}
+                for item in node.body:
+                    if isinstance(item, ast.FunctionDef):
+                        summary = _function_blocking_summary(item)
+                        if summary is not None:
+                            blockers[item.name] = summary
+                for item in node.body:
+                    if isinstance(item, ast.FunctionDef):
+                        yield from self._check_function(
+                            src, item, f"{node.name}.{item.name}", blockers
+                        )
+        # module-level functions (no class blocker table)
+        if isinstance(src.tree, ast.Module):
+            for item in src.tree.body:
+                if isinstance(item, ast.FunctionDef):
+                    yield from self._check_function(
+                        src, item, item.name, {}
+                    )
+
+    def _check_function(self, src, fn: ast.FunctionDef, symbol: str,
+                        blockers: Dict[str, Tuple[str, int]],
+                        ) -> Iterable[Finding]:
+        # the *_locked convention: body runs with the instance lock held
+        initial = ("self._lock",) if fn.name.endswith("_locked") else ()
+        scan = _FunctionScan(
+            src, symbol, initial_held=initial, class_blockers=blockers
+        )
+        for child in fn.body:
+            scan.visit(child)
+        yield from scan.findings

@@ -1,0 +1,185 @@
+"""Observability hygiene: span lifecycle rules.
+
+The tracing contract (runtime/tracing.py) is: pipeline code opens spans
+with the ``tracing.span(...)`` context manager (enter/exit pairing is
+structural), and only the runtime layer may construct raw ``Span``
+objects — those never enter a trace unless explicitly attached, so a raw
+``Span`` in handler/service/storage code is a span that silently
+vanishes, and a constructed-but-never-ended span reports no duration.
+
+Rules:
+
+- ``span-unpaired``: ``tracing.span(...)`` called outside a ``with``
+  statement — the context manager's exit IS the span end; calling it
+  bare leaks an unentered generator and no span is ever recorded.
+- ``span-direct-construction``: ``tracing.Span(...)`` / ``Span(...)``
+  constructed outside ``flyimg_tpu/runtime/`` — request code must use
+  the ``tracing.span`` context manager so spans land in the active
+  trace (the batcher's shared-span fan-out is the one sanctioned
+  exception, and it lives in runtime/).
+- ``span-unended``: a raw ``Span`` assigned to a local that neither has
+  ``.end(`` called on it nor escapes the function (returned / passed as
+  an argument / stored on an object) — it can never be ended.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from tools.flylint.core import Finding, Project, enclosing_symbol
+
+RULE_UNPAIRED = "span-unpaired"
+RULE_DIRECT = "span-direct-construction"
+RULE_UNENDED = "span-unended"
+
+RUNTIME_PREFIX = "flyimg_tpu/runtime/"
+
+
+def _is_span_ctx_call(node: ast.Call) -> bool:
+    """``tracing.span(...)`` / ``span(...)`` — the context manager."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr == "span" and isinstance(f.value, ast.Name) \
+            and f.value.id == "tracing"
+    return isinstance(f, ast.Name) and f.id == "span"
+
+
+def _is_span_ctor(node: ast.Call) -> bool:
+    """``tracing.Span(...)`` / ``Span(...)`` — raw construction."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr == "Span" and isinstance(f.value, ast.Name) \
+            and f.value.id == "tracing"
+    return isinstance(f, ast.Name) and f.id == "Span"
+
+
+class ObservabilityChecker:
+    name = "observability"
+    rules = {
+        RULE_UNPAIRED: (
+            "tracing.span(...) used outside a `with` — the span is never "
+            "entered or ended"
+        ),
+        RULE_DIRECT: (
+            "raw Span construction outside flyimg_tpu/runtime/ — use the "
+            "tracing.span context manager"
+        ),
+        RULE_UNENDED: (
+            "a raw Span local is never .end()ed and never escapes the "
+            "function"
+        ),
+    }
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        for src in project.files:
+            if src.tree is None:
+                continue
+            if src.relpath.endswith("runtime/tracing.py"):
+                continue  # the implementation itself
+            yield from self._check_file(src)
+
+    def _check_file(self, src) -> Iterable[Finding]:
+        in_runtime = RUNTIME_PREFIX in src.relpath
+        with_exprs: Set[int] = set()
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    with_exprs.add(id(item.context_expr))
+        stack: List[ast.AST] = []
+
+        def visit(node: ast.AST) -> Iterable[Finding]:
+            scoped = isinstance(
+                node,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+            )
+            if scoped:
+                stack.append(node)
+            if isinstance(node, ast.Call):
+                if _is_span_ctx_call(node) and id(node) not in with_exprs:
+                    yield Finding(
+                        rule=RULE_UNPAIRED,
+                        path=src.relpath,
+                        line=node.lineno,
+                        symbol=enclosing_symbol(stack),
+                        message=(
+                            "tracing.span(...) must be used as "
+                            "`with tracing.span(...)` — a bare call never "
+                            "enters or ends the span"
+                        ),
+                    )
+                if _is_span_ctor(node) and not in_runtime:
+                    yield Finding(
+                        rule=RULE_DIRECT,
+                        path=src.relpath,
+                        line=node.lineno,
+                        symbol=enclosing_symbol(stack),
+                        message=(
+                            "raw Span construction outside "
+                            "flyimg_tpu/runtime/ — it joins no trace; use "
+                            "`with tracing.span(...)`"
+                        ),
+                    )
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_unended(src, node, stack)
+            for child in ast.iter_child_nodes(node):
+                yield from visit(child)
+            if scoped:
+                stack.pop()
+
+        yield from visit(src.tree)
+
+    def _check_unended(self, src, fn, stack) -> Iterable[Finding]:
+        """Raw-Span locals with no ``.end(`` and no escape in this
+        function (nested defs included in the escape scan — a closure
+        may end it)."""
+        assigns = {}  # name -> lineno
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ) and _is_span_ctor(node.value):
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        assigns[target.id] = node.lineno
+        if not assigns:
+            return
+        ended: Set[str] = set()
+        escaped: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                f = node.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and f.attr == "end"
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in assigns
+                ):
+                    ended.add(f.value.id)
+                for arg in list(node.args) + [
+                    kw.value for kw in node.keywords
+                ]:
+                    if isinstance(arg, ast.Name) and arg.id in assigns:
+                        escaped.add(arg.id)
+            elif isinstance(node, ast.Return) and isinstance(
+                node.value, ast.Name
+            ) and node.value.id in assigns:
+                escaped.add(node.value.id)
+            elif isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Name
+            ) and node.value.id in assigns:
+                # re-bound somewhere (an attribute, a container): assume
+                # the new owner manages the lifecycle
+                escaped.add(node.value.id)
+        for name, lineno in assigns.items():
+            if name not in ended and name not in escaped:
+                yield Finding(
+                    rule=RULE_UNENDED,
+                    path=src.relpath,
+                    line=lineno,
+                    symbol=enclosing_symbol(stack) or fn.name,
+                    message=(
+                        f"Span local `{name}` is never `.end()`ed and "
+                        "never escapes this function — it will report no "
+                        "duration"
+                    ),
+                )

@@ -1,0 +1,459 @@
+"""Cross-artifact registry consistency.
+
+The project carries four registries that nothing type-checks:
+
+- **appconfig knobs**: ``SERVER_DEFAULTS`` in ``flyimg_tpu/appconfig.py``
+  is the declaration; ``params.by_key("<name>", ...)`` call sites are the
+  reads; ``docs/application-options.md`` is the operator contract. All
+  three must agree, both directions.
+- **fault points**: every string fired at the injector
+  (``faults.fire("<point>")``) must be declared in
+  ``flyimg_tpu/testing/faults.py``'s ``KNOWN_POINTS`` (and vice versa) —
+  an undeclared point is a fault nothing can script; a declared-but-dead
+  point is a resilience test that silently stopped covering anything.
+- **metric names**: every ``flyimg_*`` metric registered on the shared
+  registry must be listed in ``docs/observability.md``, and a bare family
+  name must be registered with ONE consistent label-key set and ONE
+  metric type across all its sites (two label shapes under one family
+  corrupts the exposition format).
+- **exception mapping**: every exception class declared in
+  ``flyimg_tpu/exceptions.py`` must have an explicit status in
+  ``service/app.py``'s ``_ERROR_STATUS`` (and every mapped class must
+  exist) — an unmapped class silently falls through as a 500.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.flylint.core import (
+    Finding,
+    Project,
+    enclosing_symbol,
+    joinedstr_template,
+    literal_str,
+)
+
+APPCONFIG = "flyimg_tpu/appconfig.py"
+FAULTS = "flyimg_tpu/testing/faults.py"
+EXCEPTIONS = "flyimg_tpu/exceptions.py"
+APP = "flyimg_tpu/service/app.py"
+OPTIONS_DOC = "docs/application-options.md"
+OBSERVABILITY_DOC = "docs/observability.md"
+
+RULE_KNOB_UNDECLARED = "knob-undeclared"
+RULE_KNOB_UNREAD = "knob-unread"
+RULE_KNOB_UNDOCUMENTED = "knob-undocumented"
+RULE_KNOB_DOC_UNKNOWN = "knob-doc-unknown"
+RULE_FAULT_UNDECLARED = "fault-point-undeclared"
+RULE_FAULT_UNUSED = "fault-point-unused"
+RULE_METRIC_UNDOCUMENTED = "metric-undocumented"
+RULE_METRIC_INCONSISTENT = "metric-inconsistent"
+RULE_EXC_UNMAPPED = "exception-unmapped"
+RULE_EXC_UNKNOWN = "exception-map-unknown"
+
+_METRIC_METHODS = {"counter": "counter", "gauge": "gauge",
+                   "histogram": "histogram"}
+_HOLE = "\x00"
+
+
+def _walk_with_symbols(tree: ast.AST):
+    """(node, symbol) pairs with the enclosing Class.function path."""
+    stack: List[ast.AST] = []
+
+    def visit(node: ast.AST):
+        scoped = isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        )
+        if scoped:
+            stack.append(node)
+        yield node, enclosing_symbol(stack)
+        for child in ast.iter_child_nodes(node):
+            yield from visit(child)
+        if scoped:
+            stack.pop()
+
+    yield from visit(tree)
+
+
+class RegistryChecker:
+    name = "registry"
+    rules = {
+        RULE_KNOB_UNDECLARED: (
+            "a by_key() knob read has no SERVER_DEFAULTS declaration"
+        ),
+        RULE_KNOB_UNREAD: (
+            "a SERVER_DEFAULTS knob is never read anywhere in flyimg_tpu/"
+        ),
+        RULE_KNOB_UNDOCUMENTED: (
+            "a SERVER_DEFAULTS knob has no docs/application-options.md row"
+        ),
+        RULE_KNOB_DOC_UNKNOWN: (
+            "docs/application-options.md documents a knob that is not "
+            "declared in SERVER_DEFAULTS"
+        ),
+        RULE_FAULT_UNDECLARED: (
+            "a faults.fire() point is not declared in "
+            "testing/faults.KNOWN_POINTS"
+        ),
+        RULE_FAULT_UNUSED: (
+            "a KNOWN_POINTS fault point is never fired by the pipeline"
+        ),
+        RULE_METRIC_UNDOCUMENTED: (
+            "a registered flyimg_* metric is not listed in "
+            "docs/observability.md"
+        ),
+        RULE_METRIC_INCONSISTENT: (
+            "one metric family is registered with conflicting label sets "
+            "or types"
+        ),
+        RULE_EXC_UNMAPPED: (
+            "an exceptions.py class has no _ERROR_STATUS mapping in "
+            "service/app.py"
+        ),
+        RULE_EXC_UNKNOWN: (
+            "_ERROR_STATUS maps a class that exceptions.py does not define"
+        ),
+    }
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        yield from self._check_knobs(project)
+        yield from self._check_faults(project)
+        yield from self._check_metrics(project)
+        yield from self._check_exceptions(project)
+
+    # -- appconfig knobs ---------------------------------------------------
+
+    def _declared_knobs(self, project: Project) -> Optional[Dict[str, int]]:
+        src = project.get(APPCONFIG)
+        if src is None or src.tree is None:
+            return None
+        for node in ast.walk(src.tree):
+            if (
+                isinstance(node, ast.AnnAssign)
+                and isinstance(node.target, ast.Name)
+                and node.target.id == "SERVER_DEFAULTS"
+                and isinstance(node.value, ast.Dict)
+            ) or (
+                isinstance(node, ast.Assign)
+                and any(
+                    isinstance(t, ast.Name) and t.id == "SERVER_DEFAULTS"
+                    for t in node.targets
+                )
+                and isinstance(node.value, ast.Dict)
+            ):
+                out: Dict[str, int] = {}
+                for key in node.value.keys:
+                    name = literal_str(key) if key is not None else None
+                    if name is not None:
+                        out[name] = key.lineno
+                return out
+        return None
+
+    def _check_knobs(self, project: Project) -> Iterable[Finding]:
+        declared = self._declared_knobs(project)
+        if declared is None:
+            return  # not this project shape (fixture runs)
+        # reads: by_key("<literal>") anywhere scanned
+        reads: Dict[str, Tuple[str, int]] = {}
+        for src in project.files:
+            if src.tree is None or src.relpath == APPCONFIG:
+                continue
+            for node, symbol in _walk_with_symbols(src.tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "by_key"
+                    and node.args
+                ):
+                    continue
+                key = literal_str(node.args[0])
+                if key is None:
+                    continue
+                reads.setdefault(key, (src.relpath, node.lineno))
+                if key not in declared:
+                    yield Finding(
+                        rule=RULE_KNOB_UNDECLARED,
+                        path=src.relpath,
+                        line=node.lineno,
+                        symbol=symbol,
+                        message=(
+                            f"knob `{key}` is read here but has no "
+                            "SERVER_DEFAULTS declaration (undeclared "
+                            "knobs silently fall back to call-site "
+                            "defaults that can drift apart)"
+                        ),
+                    )
+        doc = project.read_text(OPTIONS_DOC)
+        doc_keys: Set[str] = set()
+        if doc is not None:
+            for line in doc.splitlines():
+                if line.startswith("|"):
+                    first_cell = line.split("|")[1] if "|" in line[1:] else ""
+                    doc_keys.update(re.findall(r"`([a-z0-9_]+)`", first_cell))
+        for key, lineno in declared.items():
+            if key not in reads:
+                yield Finding(
+                    rule=RULE_KNOB_UNREAD,
+                    path=APPCONFIG,
+                    line=lineno,
+                    symbol="SERVER_DEFAULTS",
+                    message=(
+                        f"knob `{key}` is declared but never read via "
+                        "by_key() anywhere in the scanned tree (dead "
+                        "config, or the read lost its literal)"
+                    ),
+                )
+            if doc is not None and key not in doc_keys:
+                yield Finding(
+                    rule=RULE_KNOB_UNDOCUMENTED,
+                    path=APPCONFIG,
+                    line=lineno,
+                    symbol="SERVER_DEFAULTS",
+                    message=(
+                        f"knob `{key}` has no row in {OPTIONS_DOC}"
+                    ),
+                )
+        if doc is not None:
+            for key in sorted(doc_keys - set(declared)):
+                yield Finding(
+                    rule=RULE_KNOB_DOC_UNKNOWN,
+                    path=OPTIONS_DOC,
+                    line=1,
+                    symbol="",
+                    message=(
+                        f"documented knob `{key}` is not declared in "
+                        "SERVER_DEFAULTS (stale doc, or a missing "
+                        "declaration)"
+                    ),
+                )
+
+    # -- fault points ------------------------------------------------------
+
+    def _known_points(self, project: Project) -> Optional[Dict[str, int]]:
+        src = project.get(FAULTS)
+        if src is None or src.tree is None:
+            return None
+        for node in ast.walk(src.tree):
+            if (
+                isinstance(node, ast.Assign)
+                and any(
+                    isinstance(t, ast.Name) and t.id == "KNOWN_POINTS"
+                    for t in node.targets
+                )
+            ):
+                values = getattr(node.value, "elts", None)
+                if values is None and isinstance(node.value, ast.Call):
+                    # frozenset({...}) / frozenset((...)) shape
+                    if node.value.args and hasattr(
+                        node.value.args[0], "elts"
+                    ):
+                        values = node.value.args[0].elts
+                if values is None:
+                    return {}
+                return {
+                    literal_str(v): v.lineno
+                    for v in values
+                    if literal_str(v) is not None
+                }
+        return None
+
+    def _check_faults(self, project: Project) -> Iterable[Finding]:
+        known = self._known_points(project)
+        if known is None:
+            return
+        fired: Dict[str, Tuple[str, int]] = {}
+        for src in project.files:
+            if src.tree is None:
+                continue
+            for node, symbol in _walk_with_symbols(src.tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "fire"
+                    and node.args
+                ):
+                    continue
+                template = joinedstr_template(node.args[0], _HOLE)
+                if template is None:
+                    continue
+                if _HOLE in template:
+                    # dynamic point (f-string): its static prefix must
+                    # match at least one declared point
+                    prefix = template.split(_HOLE, 1)[0]
+                    if not any(p.startswith(prefix) for p in known):
+                        yield Finding(
+                            rule=RULE_FAULT_UNDECLARED,
+                            path=src.relpath,
+                            line=node.lineno,
+                            symbol=symbol,
+                            message=(
+                                f"dynamic fault point `{prefix}…` matches "
+                                "no declared KNOWN_POINTS entry"
+                            ),
+                        )
+                    else:
+                        for p in known:
+                            if p.startswith(prefix):
+                                fired.setdefault(
+                                    p, (src.relpath, node.lineno)
+                                )
+                    continue
+                fired.setdefault(template, (src.relpath, node.lineno))
+                if template not in known:
+                    yield Finding(
+                        rule=RULE_FAULT_UNDECLARED,
+                        path=src.relpath,
+                        line=node.lineno,
+                        symbol=symbol,
+                        message=(
+                            f"fault point `{template}` is fired here but "
+                            "not declared in testing/faults.KNOWN_POINTS"
+                        ),
+                    )
+        for point, lineno in known.items():
+            if point not in fired:
+                yield Finding(
+                    rule=RULE_FAULT_UNUSED,
+                    path=FAULTS,
+                    line=lineno,
+                    symbol="KNOWN_POINTS",
+                    message=(
+                        f"declared fault point `{point}` is never fired "
+                        "by any scanned pipeline code"
+                    ),
+                )
+
+    # -- metric names ------------------------------------------------------
+
+    def _check_metrics(self, project: Project) -> Iterable[Finding]:
+        doc = project.read_text(OBSERVABILITY_DOC)
+        # family -> {"types": {...}, "labels": {frozenset: (path, line)},
+        #            "site": (path, line)}
+        families: Dict[str, Dict] = {}
+        for src in project.files:
+            if src.tree is None:
+                continue
+            for node, symbol in _walk_with_symbols(src.tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _METRIC_METHODS
+                    and node.args
+                ):
+                    continue
+                template = joinedstr_template(node.args[0], _HOLE)
+                if template is None or not template.startswith("flyimg_"):
+                    continue
+                bare = template.split("{", 1)[0]
+                labels = frozenset(
+                    re.findall(r'(\w+)\s*=\s*"', template)
+                )
+                mtype = _METRIC_METHODS[node.func.attr]
+                fam = families.setdefault(bare, {
+                    "types": {}, "labels": {},
+                    "site": (src.relpath, node.lineno, symbol),
+                })
+                fam["types"].setdefault(mtype, (src.relpath, node.lineno))
+                fam["labels"].setdefault(labels, (src.relpath, node.lineno))
+        for bare, fam in sorted(families.items()):
+            path, line, symbol = fam["site"]
+            if len(fam["types"]) > 1:
+                yield Finding(
+                    rule=RULE_METRIC_INCONSISTENT,
+                    path=path, line=line, symbol=symbol,
+                    message=(
+                        f"metric family `{bare}` is registered as "
+                        f"{sorted(fam['types'])} at different sites — one "
+                        "family must have one type"
+                    ),
+                )
+            if len(fam["labels"]) > 1:
+                shapes = sorted(
+                    "{" + ",".join(sorted(ls)) + "}" for ls in fam["labels"]
+                )
+                yield Finding(
+                    rule=RULE_METRIC_INCONSISTENT,
+                    path=path, line=line, symbol=symbol,
+                    message=(
+                        f"metric family `{bare}` is registered with "
+                        f"conflicting label sets {shapes} — scrapes of one "
+                        "family must share one label schema"
+                    ),
+                )
+            if doc is not None and bare not in doc:
+                yield Finding(
+                    rule=RULE_METRIC_UNDOCUMENTED,
+                    path=path, line=line, symbol=symbol,
+                    message=(
+                        f"metric `{bare}` is registered here but not "
+                        f"listed in {OBSERVABILITY_DOC}"
+                    ),
+                )
+
+    # -- exception mapping -------------------------------------------------
+
+    def _check_exceptions(self, project: Project) -> Iterable[Finding]:
+        exc_src = project.get(EXCEPTIONS)
+        app_src = project.get(APP)
+        if exc_src is None or exc_src.tree is None or app_src is None \
+                or app_src.tree is None:
+            return
+        declared: Dict[str, int] = {}
+        root_classes: Set[str] = set()
+        for node in exc_src.tree.body if isinstance(
+            exc_src.tree, ast.Module
+        ) else []:
+            if isinstance(node, ast.ClassDef):
+                bases = {
+                    b.id for b in node.bases if isinstance(b, ast.Name)
+                }
+                if bases == {"Exception"} or not bases:
+                    root_classes.add(node.name)
+                declared[node.name] = node.lineno
+        mapped: Dict[str, int] = {}
+        map_line = 1
+        for node in ast.walk(app_src.tree):
+            if (
+                isinstance(node, ast.Assign)
+                and any(
+                    isinstance(t, ast.Name) and t.id == "_ERROR_STATUS"
+                    for t in node.targets
+                )
+                and isinstance(node.value, ast.Dict)
+            ):
+                map_line = node.lineno
+                for key in node.value.keys:
+                    if isinstance(key, ast.Name):
+                        mapped[key.id] = key.lineno
+        if not mapped:
+            return
+        for name, lineno in declared.items():
+            if name in root_classes:
+                continue  # the base class is the fall-through, not a leaf
+            if name not in mapped:
+                yield Finding(
+                    rule=RULE_EXC_UNMAPPED,
+                    path=EXCEPTIONS,
+                    line=lineno,
+                    symbol=name,
+                    message=(
+                        f"exception `{name}` has no explicit status in "
+                        "service/app.py _ERROR_STATUS (it silently falls "
+                        "through to 500)"
+                    ),
+                )
+        for name, lineno in mapped.items():
+            if name not in declared:
+                yield Finding(
+                    rule=RULE_EXC_UNKNOWN,
+                    path=APP,
+                    line=lineno or map_line,
+                    symbol="_ERROR_STATUS",
+                    message=(
+                        f"_ERROR_STATUS maps `{name}`, which "
+                        "exceptions.py does not define"
+                    ),
+                )

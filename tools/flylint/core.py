@@ -1,0 +1,312 @@
+"""flylint framework: project model, findings, suppressions, baseline.
+
+Checkers are classes with a ``name``, a ``rules`` mapping (rule id ->
+one-line description) and a ``run(project)`` generator of ``Finding``s.
+They receive the whole :class:`Project` (parsed ASTs plus raw docs), so
+cross-artifact checks (knob vs doc vs call site) are first-class rather
+than bolted on.
+
+Finding identity (the baseline fingerprint) deliberately excludes line
+numbers: a baseline accepted for ``(rule, path, symbol, message)`` must
+survive unrelated edits above the finding. Line numbers are for humans.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*flylint:\s*(disable|disable-file)\s*=\s*([A-Za-z0-9_,\- ]+)"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at one location."""
+
+    rule: str
+    path: str  # project-root-relative, forward slashes
+    line: int
+    message: str
+    symbol: str = ""  # enclosing ``Class.function`` (fingerprint stability)
+
+    def fingerprint(self) -> str:
+        h = hashlib.blake2b(digest_size=9)
+        h.update(
+            f"{self.rule}|{self.path}|{self.symbol}|{self.message}".encode()
+        )
+        return h.hexdigest()
+
+    def format(self) -> str:
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{self.path}:{self.line}: {self.rule}: {self.message}{sym}"
+
+    def as_dict(self) -> Dict[str, object]:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "symbol": self.symbol,
+            "message": self.message,
+            "fingerprint": self.fingerprint(),
+        }
+
+
+class SourceFile:
+    """One parsed python file plus its suppression map."""
+
+    def __init__(self, root: str, relpath: str, text: str) -> None:
+        self.relpath = relpath.replace(os.sep, "/")
+        self.path = os.path.join(root, relpath)
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self.parse_error: Optional[str] = None
+        try:
+            self.tree = ast.parse(text, filename=self.relpath)
+        except SyntaxError as exc:  # surfaced as a finding by run_checkers
+            self.parse_error = f"syntax error: {exc.msg} (line {exc.lineno})"
+        # line -> rules suppressed there; "*" suppresses every rule
+        self.suppressions: Dict[int, Set[str]] = {}
+        self.file_suppressions: Set[str] = set()
+        self._parse_suppressions()
+
+    def _parse_suppressions(self) -> None:
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if m is None:
+                continue
+            rules = {
+                r.strip() for r in m.group(2).split(",") if r.strip()
+            }
+            if m.group(1) == "disable-file":
+                self.file_suppressions |= rules
+            elif line.strip().startswith("#"):
+                # standalone comment: applies to the next line
+                self.suppressions.setdefault(i + 1, set()).update(rules)
+            else:
+                # trailing comment: applies to its own line
+                self.suppressions.setdefault(i, set()).update(rules)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.file_suppressions or "*" in self.file_suppressions:
+            return True
+        rules = self.suppressions.get(line, ())
+        return rule in rules or "*" in rules
+
+
+class Project:
+    """The scanned file set plus non-python artifacts checkers read.
+
+    ``exclude`` prefixes (default: flylint's own package) are skipped —
+    the linter's fixtures and lock-wrapping witness would only add noise
+    to a project scan; flylint's own tests run it on purpose-built
+    fixture trees instead.
+    """
+
+    DEFAULT_EXCLUDES = ("tools/flylint",)
+
+    def __init__(self, root: str, paths: Iterable[str],
+                 exclude: Optional[Iterable[str]] = None) -> None:
+        self.root = os.path.abspath(root)
+        self.exclude = tuple(
+            self.DEFAULT_EXCLUDES if exclude is None else exclude
+        )
+        self.files: List[SourceFile] = []
+        seen: Set[str] = set()
+        for rel in self._expand(paths):
+            if any(
+                rel.replace(os.sep, "/").startswith(p)
+                for p in self.exclude
+            ):
+                continue
+            if rel in seen:
+                continue
+            seen.add(rel)
+            full = os.path.join(self.root, rel)
+            try:
+                with open(full, "r", encoding="utf-8") as fh:
+                    text = fh.read()
+            except OSError:
+                continue
+            self.files.append(SourceFile(self.root, rel, text))
+
+    def _expand(self, paths: Iterable[str]) -> List[str]:
+        out: List[str] = []
+        for p in paths:
+            full = os.path.join(self.root, p)
+            if os.path.isfile(full) and p.endswith(".py"):
+                out.append(os.path.relpath(full, self.root))
+            elif os.path.isdir(full):
+                for dirpath, dirnames, filenames in os.walk(full):
+                    dirnames[:] = sorted(
+                        d for d in dirnames
+                        if d not in ("__pycache__", ".git")
+                    )
+                    for name in sorted(filenames):
+                        if name.endswith(".py"):
+                            out.append(
+                                os.path.relpath(
+                                    os.path.join(dirpath, name), self.root
+                                )
+                            )
+        return out
+
+    def get(self, relpath: str) -> Optional[SourceFile]:
+        relpath = relpath.replace(os.sep, "/")
+        for f in self.files:
+            if f.relpath == relpath:
+                return f
+        return None
+
+    def read_text(self, relpath: str) -> Optional[str]:
+        """Raw text of any project artifact (docs, configs); None when
+        absent — checkers turn that into a finding, not a crash."""
+        full = os.path.join(self.root, relpath)
+        try:
+            with open(full, "r", encoding="utf-8") as fh:
+                return fh.read()
+        except OSError:
+            return None
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers
+
+
+def enclosing_symbol(stack: List[ast.AST]) -> str:
+    """``Class.method`` path from a node-ancestor stack."""
+    parts = [
+        n.name for n in stack
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef))
+    ]
+    return ".".join(parts)
+
+
+def literal_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def joinedstr_template(node: ast.AST, hole: str = "\x00") -> Optional[str]:
+    """An f-string (or plain string) flattened to a template with ``hole``
+    where formatted values sit — enough to recover a metric name's static
+    prefix and its label keys."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts: List[str] = []
+        for value in node.values:
+            if isinstance(value, ast.Constant) and isinstance(
+                value.value, str
+            ):
+                parts.append(value.value)
+            else:
+                parts.append(hole)
+        return "".join(parts)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# baseline
+
+
+def load_baseline(path: str) -> Dict[str, Dict[str, object]]:
+    """fingerprint -> entry. Missing file = empty baseline."""
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except OSError:
+        return {}
+    return {
+        str(e["fingerprint"]): e for e in doc.get("entries", [])
+    }
+
+
+def write_baseline(path: str, findings: List[Finding],
+                   previous: Optional[Dict[str, Dict[str, object]]] = None,
+                   ) -> None:
+    """Serialize ``findings`` as the new baseline, carrying forward any
+    justification already written for a surviving fingerprint."""
+    previous = previous or {}
+    entries = []
+    for f in sorted(findings, key=lambda f: (f.path, f.rule, f.line)):
+        fp = f.fingerprint()
+        entries.append({
+            "fingerprint": fp,
+            "rule": f.rule,
+            "path": f.path,
+            "symbol": f.symbol,
+            "message": f.message,
+            "justification": str(
+                previous.get(fp, {}).get("justification", "")
+            ),
+        })
+    doc = {
+        "_comment": (
+            "flylint accepted-findings baseline (docs/static-analysis.md)."
+            " Every entry MUST carry a written justification; regenerate "
+            "with `python -m tools.flylint --update-baseline` (which "
+            "preserves justifications for surviving fingerprints)."
+        ),
+        "version": 1,
+        "entries": entries,
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+
+
+# ---------------------------------------------------------------------------
+# driver
+
+
+@dataclass
+class RunResult:
+    findings: List[Finding] = field(default_factory=list)  # not suppressed
+    suppressed: int = 0
+    baselined: List[Finding] = field(default_factory=list)
+    new: List[Finding] = field(default_factory=list)  # not in baseline
+    stale_baseline: List[Dict[str, object]] = field(default_factory=list)
+
+
+def run_checkers(project: Project, checkers: Iterable,
+                 baseline: Optional[Dict[str, Dict[str, object]]] = None,
+                 ) -> RunResult:
+    result = RunResult()
+    baseline = baseline or {}
+    for f in project.files:
+        if f.parse_error:
+            result.findings.append(Finding(
+                rule="parse-error", path=f.relpath, line=1,
+                message=f.parse_error,
+            ))
+    for checker in checkers:
+        for finding in checker.run(project):
+            src = project.get(finding.path)
+            if src is not None and src.suppressed(
+                finding.rule, finding.line
+            ):
+                result.suppressed += 1
+                continue
+            result.findings.append(finding)
+    result.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    seen_fps: Set[str] = set()
+    for finding in result.findings:
+        fp = finding.fingerprint()
+        seen_fps.add(fp)
+        if fp in baseline:
+            result.baselined.append(finding)
+        else:
+            result.new.append(finding)
+    result.stale_baseline = [
+        e for fp, e in baseline.items() if fp not in seen_fps
+    ]
+    return result

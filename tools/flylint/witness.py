@@ -1,0 +1,400 @@
+"""Runtime lock-order witness: deadlock detection without the deadlock.
+
+Static analysis sees lexical lock scopes; it cannot see the GLOBAL
+acquisition order across threads and modules — the thing an AB/BA
+deadlock is made of. This module instruments ``threading.Lock`` /
+``threading.RLock`` construction (repo-local creation sites only), tracks
+each thread's held-lock stack, and records a directed edge
+``site(A) -> site(B)`` the first time any thread acquires B while holding
+A — with the full acquisition stack captured at that moment. At session
+end, a cycle in the site graph is reported TSan-style: every edge on the
+cycle with its stack, i.e. "thread X held A (acquired at …) when it took
+B (stack)" and "thread Y held B when it took A (stack)". A cycle means
+two code paths disagree about lock order — a latent deadlock, even if the
+test run never interleaved badly enough to hang.
+
+Identity is the lock's CREATION SITE (``file:line`` of the ``Lock()``
+call), not the instance: instances churn per request, sites are the
+program's lock-order contract. Self-edges (two instances from one site)
+are ignored — e.g. two metric counters locking in sequence.
+
+Opt-in: ``FLYIMG_LOCK_WITNESS=1`` makes ``tests/conftest.py`` call
+:func:`install` before anything constructs app objects, and fail the
+pytest session (exit status 3) when :func:`session_report` finds a cycle.
+Cost: a few dict operations per tracked acquire; locks created outside
+the repo tree (jax, stdlib) get REAL locks — zero overhead.
+
+Scoped self-tests build a private :class:`LockOrderWitness` and wrap
+locks by hand (``tests/test_flylint.py``) so a seeded AB/BA cycle cannot
+leak into the session-wide graph.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import traceback
+from typing import Dict, List, Optional, Tuple
+
+__all__ = [
+    "LockOrderWitness",
+    "install",
+    "uninstall",
+    "installed_witness",
+    "session_report",
+]
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_SELF_FILES = (os.path.abspath(__file__), threading.__file__)
+
+# originals captured at import: install() replaces the threading factories
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+
+class _Held:
+    __slots__ = ("lock", "acquired_at")
+
+    def __init__(self, lock, acquired_at: str) -> None:
+        self.lock = lock
+        self.acquired_at = acquired_at
+
+
+class _Edge:
+    """First observation of ``site_a -> site_b``: enough context to
+    reconstruct the hazard without re-running."""
+
+    __slots__ = ("site_a", "site_b", "thread", "held_at", "stack")
+
+    def __init__(self, site_a: str, site_b: str, thread: str,
+                 held_at: str, stack: str) -> None:
+        self.site_a = site_a
+        self.site_b = site_b
+        self.thread = thread
+        self.held_at = held_at  # where A was acquired (file:line)
+        self.stack = stack      # full stack at B's acquisition
+
+
+def _caller_site(skip_self: bool = True) -> str:
+    """file:line of the nearest frame outside this module and
+    threading.py — the acquisition (or creation) site."""
+    frame = sys._getframe(1)
+    while frame is not None:
+        filename = frame.f_code.co_filename
+        if not skip_self or (
+            os.path.abspath(filename) not in _SELF_FILES
+            and not filename.endswith("threading.py")
+        ):
+            return f"{filename}:{frame.f_lineno}"
+        frame = frame.f_back
+    return "<unknown>"
+
+
+class LockOrderWitness:
+    """The lock-order graph builder. One global instance is armed by
+    :func:`install`; tests may build private ones."""
+
+    def __init__(self, root: Optional[str] = None) -> None:
+        self.root = os.path.abspath(root or _REPO_ROOT)
+        # (site_a, site_b) -> first-observation edge
+        self._edges: Dict[Tuple[str, str], _Edge] = {}
+        self._tls = threading.local()
+        self.tracked_locks = 0
+
+    # -- factories ---------------------------------------------------------
+
+    def _creation_site(self) -> Optional[str]:
+        """Creation site when it falls under ``root``, else None (the
+        caller should hand out a real, untracked lock)."""
+        frame = sys._getframe(2)
+        while frame is not None:
+            filename = frame.f_code.co_filename
+            if (
+                os.path.abspath(filename) not in _SELF_FILES
+                and not filename.endswith("threading.py")
+            ):
+                full = os.path.abspath(filename)
+                if full.startswith(self.root + os.sep):
+                    return f"{os.path.relpath(full, self.root)}:" \
+                           f"{frame.f_lineno}"
+                return None
+            frame = frame.f_back
+        return None
+
+    def make_lock(self):
+        site = self._creation_site()
+        if site is None:
+            return _REAL_LOCK()
+        self.tracked_locks += 1
+        return _TrackedLock(self, _REAL_LOCK(), site)
+
+    def make_rlock(self):
+        site = self._creation_site()
+        if site is None:
+            return _REAL_RLOCK()
+        self.tracked_locks += 1
+        return _TrackedRLock(self, _REAL_RLOCK(), site)
+
+    def wrap_lock(self, site: str):
+        """Explicit-site tracked lock (self-tests)."""
+        self.tracked_locks += 1
+        return _TrackedLock(self, _REAL_LOCK(), site)
+
+    # -- event stream ------------------------------------------------------
+
+    def _held(self) -> List[_Held]:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def note_acquire(self, lock) -> None:
+        held = self._held()
+        acquired_at = _caller_site()
+        for prev in held:
+            if prev.lock.site == lock.site:
+                continue  # instance churn from one site is not an order
+            key = (prev.lock.site, lock.site)
+            if key not in self._edges:
+                # full stack only on a NEW edge (the expensive part)
+                self._edges[key] = _Edge(
+                    prev.lock.site, lock.site,
+                    threading.current_thread().name,
+                    prev.acquired_at,
+                    "".join(traceback.format_stack(sys._getframe(1))),
+                )
+        held.append(_Held(lock, acquired_at))
+
+    def note_release(self, lock) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i].lock is lock:
+                del held[i]
+                return
+        # released on a thread that never acquired it (hand-off): the
+        # order contract is per-thread, so there is nothing to unwind
+
+    # -- analysis ----------------------------------------------------------
+
+    def find_cycle(self) -> Optional[List[str]]:
+        """One cycle in the site graph as ``[s0, s1, ..., s0]``, or
+        None. DFS with the standard three colors."""
+        graph: Dict[str, List[str]] = {}
+        for a, b in self._edges:
+            graph.setdefault(a, []).append(b)
+        WHITE, GREY, BLACK = 0, 1, 2
+        color = {node: WHITE for node in graph}
+        parent: Dict[str, str] = {}
+
+        for start in sorted(graph):
+            if color.get(start, WHITE) != WHITE:
+                continue
+            stack = [(start, iter(graph.get(start, ())))]
+            color[start] = GREY
+            while stack:
+                node, it = stack[-1]
+                advanced = False
+                for nxt in it:
+                    c = color.get(nxt, WHITE)
+                    if c == GREY:
+                        # found: unwind the grey path node -> ... -> nxt
+                        cycle = [nxt, node]
+                        cur = node
+                        while cur != nxt:
+                            cur = parent[cur]
+                            cycle.append(cur)
+                        cycle.reverse()
+                        return cycle
+                    if c == WHITE:
+                        color[nxt] = GREY
+                        parent[nxt] = node
+                        stack.append((nxt, iter(graph.get(nxt, ()))))
+                        advanced = True
+                        break
+                if not advanced:
+                    color[node] = BLACK
+                    stack.pop()
+        return None
+
+    def report(self) -> Optional[str]:
+        """Human-readable TSan-style cycle report, or None when the
+        order graph is acyclic."""
+        cycle = self.find_cycle()
+        if cycle is None:
+            return None
+        lines = [
+            "lock-order cycle detected by the flylint witness "
+            "(tools/flylint/witness.py):",
+            "  a consistent global acquisition order does not exist — "
+            "two code paths can deadlock.",
+            "  cycle: " + "  ->  ".join(cycle),
+            "",
+        ]
+        for a, b in zip(cycle, cycle[1:]):
+            edge = self._edges.get((a, b))
+            if edge is None:  # pragma: no cover - cycle implies edges
+                continue
+            lines.append(
+                f"edge {a} -> {b}: thread {edge.thread!r} held the lock "
+                f"created at {a} (acquired at {edge.held_at}) while "
+                f"acquiring the lock created at {b}:"
+            )
+            lines.append(edge.stack.rstrip("\n"))
+            lines.append("")
+        lines.append(
+            "Fix: make every path acquire these locks in one order (or "
+            "drop to a single lock); see docs/static-analysis.md "
+            "'Lock-order witness'."
+        )
+        return "\n".join(lines)
+
+    def edge_count(self) -> int:
+        return len(self._edges)
+
+
+class _TrackedLock:
+    """threading.Lock proxy feeding the witness. Context-manager and
+    acquire/release compatible; Condition(lock) falls back to plain
+    acquire/release for non-RLocks, which routes through here."""
+
+    __slots__ = ("_witness", "_inner", "site")
+
+    def __init__(self, witness: LockOrderWitness, inner, site: str) -> None:
+        self._witness = witness
+        self._inner = inner
+        self.site = site
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._witness.note_acquire(self)
+        return ok
+
+    def release(self) -> None:
+        self._witness.note_release(self)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def _at_fork_reinit(self) -> None:  # pragma: no cover - fork safety
+        self._inner._at_fork_reinit()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<witness lock {self.site} {self._inner!r}>"
+
+
+class _TrackedRLock:
+    """threading.RLock proxy: the witness sees only the OUTERMOST
+    acquire/release (reentrancy is not an ordering event). Implements the
+    ``_release_save``/``_acquire_restore``/``_is_owned`` trio so
+    ``threading.Condition`` (which fully releases an RLock inside
+    ``wait``) keeps the held-stack truthful across waits."""
+
+    __slots__ = ("_witness", "_inner", "site", "_depths")
+
+    def __init__(self, witness: LockOrderWitness, inner, site: str) -> None:
+        self._witness = witness
+        self._inner = inner
+        self.site = site
+        self._depths: Dict[int, int] = {}  # thread id -> recursion depth
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            tid = threading.get_ident()
+            depth = self._depths.get(tid, 0) + 1
+            self._depths[tid] = depth
+            if depth == 1:
+                self._witness.note_acquire(self)
+        return ok
+
+    __enter__ = acquire
+
+    def release(self) -> None:
+        tid = threading.get_ident()
+        depth = self._depths.get(tid, 0) - 1
+        if depth <= 0:
+            self._depths.pop(tid, None)
+            self._witness.note_release(self)
+        else:
+            self._depths[tid] = depth
+        self._inner.release()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    # Condition integration ------------------------------------------------
+
+    def _release_save(self):
+        tid = threading.get_ident()
+        depth = self._depths.pop(tid, 0)
+        self._witness.note_release(self)
+        return self._inner._release_save(), depth
+
+    def _acquire_restore(self, state) -> None:
+        inner_state, depth = state
+        self._inner._acquire_restore(inner_state)
+        self._depths[threading.get_ident()] = max(depth, 1)
+        self._witness.note_acquire(self)
+
+    def _is_owned(self) -> bool:
+        return self._inner._is_owned()
+
+    def _at_fork_reinit(self) -> None:  # pragma: no cover - fork safety
+        self._inner._at_fork_reinit()
+        self._depths.clear()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<witness rlock {self.site} {self._inner!r}>"
+
+
+# ---------------------------------------------------------------------------
+# global installation
+
+_INSTALLED: Optional[LockOrderWitness] = None
+
+
+def install(root: Optional[str] = None) -> LockOrderWitness:
+    """Arm the witness process-wide: ``threading.Lock``/``RLock`` become
+    site-tracking factories for repo-local creation sites. Idempotent.
+    Must run BEFORE the code under test constructs its locks — in pytest,
+    tests/conftest.py does this at import when FLYIMG_LOCK_WITNESS=1."""
+    global _INSTALLED
+    if _INSTALLED is not None:
+        return _INSTALLED
+    witness = LockOrderWitness(root)
+    threading.Lock = witness.make_lock
+    threading.RLock = witness.make_rlock
+    _INSTALLED = witness
+    return witness
+
+
+def uninstall() -> None:
+    """Restore the real factories (existing tracked locks keep working —
+    their wrappers hold real locks inside)."""
+    global _INSTALLED
+    threading.Lock = _REAL_LOCK
+    threading.RLock = _REAL_RLOCK
+    _INSTALLED = None
+
+
+def installed_witness() -> Optional[LockOrderWitness]:
+    return _INSTALLED
+
+
+def session_report() -> Optional[str]:
+    """The installed witness's cycle report (None = no witness armed, or
+    no cycle)."""
+    if _INSTALLED is None:
+        return None
+    return _INSTALLED.report()
