@@ -151,6 +151,26 @@ SERVER_DEFAULTS: Dict[str, Any] = {
     # remembers the last traced observation's trace id, linking /metrics
     # tails straight to /debug/traces/{id}
     "metrics_exemplars": True,
+    # --- performance observatory (runtime/costledger.py,
+    # runtime/profiling.py, runtime/flightrecorder.py;
+    # docs/observability.md "Performance observatory") ---
+    # per-plan cost-ledger table bound (least-recently-launched evicted;
+    # since-boot aggregates survive eviction)
+    "costledger_max_entries": 256,
+    # on-demand profiler (/debug/profile, debug-gated): ceiling on the
+    # per-capture batch budget, hard capture-duration bound (the
+    # watchdog stops an armed-but-idle capture), and the capture dir
+    # ('' -> <tmp_dir>/profiles)
+    "profiling_max_batches": 16,
+    "profiling_max_seconds": 30.0,
+    "profiling_dir": "",
+    # batch flight recorder: ring capacity (launch records), dump dir
+    # ('' -> <tmp_dir>/flightrecorder), minimum seconds between dumps
+    # (an incident storm must not spam the disk), retained dump files
+    "flightrecorder_size": 256,
+    "flightrecorder_dump_dir": "",
+    "flightrecorder_min_dump_interval_s": 30.0,
+    "flightrecorder_max_dumps": 16,
     # --- perf-regression gate defaults (tools/perf_gate.py; CLI flags
     # override; benchmarks/README.md "baseline refresh policy") ---
     # a stage regresses when its calibrated median exceeds
@@ -158,6 +178,10 @@ SERVER_DEFAULTS: Dict[str, Any] = {
     "perf_gate_tolerance": 1.6,
     "perf_gate_repeats": 30,
     "perf_gate_warmup": 3,
+    # per-plan FLOP/byte regression band: XLA cost analysis is
+    # deterministic for one jax version, so the band only absorbs
+    # compiler-version drift (much tighter than the latency bands)
+    "perf_gate_cost_tolerance": 1.2,
     # --- graceful degradation under overload (runtime/brownout.py;
     # docs/degradation.md). EVERYTHING here defaults off/fail-safe:
     # with the defaults the serving path is byte-for-byte the

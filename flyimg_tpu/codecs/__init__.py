@@ -10,6 +10,7 @@ bytes never cross a process boundary on the way to the device.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Tuple
 
 import numpy as np
@@ -19,6 +20,38 @@ from flyimg_tpu.codecs import native_codec
 from flyimg_tpu.codecs import pil_codec
 from flyimg_tpu.codecs.exif import apply_orientation, jpeg_orientation
 from flyimg_tpu.codecs.pil_codec import DecodedImage
+
+# lazy ref to the host-pool utilization trackers (runtime/metrics.py):
+# importing flyimg_tpu.runtime at module scope would drag the whole batch
+# runtime (and jax) into every bare codec import
+_host_pool_fn = None
+
+
+def _host_pool(name: str):
+    global _host_pool_fn
+    if _host_pool_fn is None:
+        from flyimg_tpu.runtime.metrics import host_pool as _hp
+
+        _host_pool_fn = _hp
+    return _host_pool_fn(name)
+
+
+def _pool_tracked(pool_name: str):
+    """Wrap a codec entry point so its wall time feeds the rolling
+    busy-ratio tracker behind ``flyimg_host_pool_busy_ratio{pool=}`` —
+    the per-stage host-utilization measurement the codec-overhaul work
+    (ROADMAP item 4) gates on. Concurrent callers stack, so a ratio
+    above 1.0 reads as an oversubscribed stage."""
+
+    def wrap(fn):
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            with _host_pool(pool_name).track():
+                return fn(*args, **kwargs)
+
+        return inner
+
+    return wrap
 
 
 def media_info(data: bytes) -> MediaInfo:
@@ -47,6 +80,7 @@ def _dct_scale_num(src_w: int, src_h: int, hint: Tuple[int, int]) -> int:
     return 8
 
 
+@_pool_tracked("decode")
 def decode(
     data: bytes,
     *,
@@ -136,6 +170,7 @@ def jpeg_batch_scale_num(data_info: MediaInfo, target_hint) -> int:
     return 8
 
 
+@_pool_tracked("decode")
 def batch_jpeg_decode(items: list) -> list:
     """Aux-group runner: decode many JPEGs in ONE native pool call — C
     worker threads run in parallel regardless of Python thread counts.
@@ -195,6 +230,7 @@ def parse_sampling_factor(value) -> Tuple[int, int]:
     )
 
 
+@_pool_tracked("encode")
 def batch_jpeg_encode(items: list) -> list:
     """Aux-group runner: encode many RGB frames to JPEG in ONE native pool
     call — C worker threads run the (expensive) trellis DP in parallel.
@@ -218,6 +254,7 @@ def batch_jpeg_encode(items: list) -> list:
     )
 
 
+@_pool_tracked("encode")
 def encode(
     image: np.ndarray,
     fmt: str,

@@ -17,9 +17,11 @@ application order used by the reference.
 
 from __future__ import annotations
 
+import threading
+import time
 from dataclasses import dataclass
 from functools import lru_cache
-from typing import Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -155,6 +157,169 @@ def make_program_fn(
     return program
 
 
+# cached module ref for the per-plan cost ledger (lazy: importing
+# flyimg_tpu.runtime at module scope would cycle through the batcher,
+# which imports this module)
+_costledger_mod = None
+
+
+def _ledger():
+    global _costledger_mod
+    if _costledger_mod is None:
+        from flyimg_tpu.runtime import costledger as _c
+
+        _costledger_mod = _c
+    return _costledger_mod.get_ledger()
+
+
+def plan_descriptor(plan: TransformPlan, *, in_shape=None, batch=None,
+                    resample_out=None, pad_canvas=None,
+                    rotate_dynamic=False) -> Dict[str, object]:
+    """Compact human-readable program identity for the cost ledger /
+    ``/debug/plans`` — which ops the program fuses and at what static
+    shapes, without dumping the whole TransformPlan repr."""
+    ops = []
+    if resample_out is not None:
+        ops.append("resample")
+    if pad_canvas is not None:
+        ops.append("extent_pad")
+    if plan.colorspace:
+        ops.append(f"colorspace:{plan.colorspace}")
+    if plan.monochrome:
+        ops.append("monochrome")
+    if plan.rotate is not None:
+        ops.append("rotate_dynamic" if rotate_dynamic else "rotate")
+    if plan.unsharp is not None:
+        ops.append("unsharp")
+    if plan.sharpen is not None:
+        ops.append("sharpen")
+    if plan.blur is not None:
+        ops.append("blur")
+    desc: Dict[str, object] = {"ops": ops or ["copy"]}
+    if in_shape is not None:
+        desc["in_shape"] = list(in_shape)
+    if batch is not None:
+        desc["batch"] = int(batch)
+    if resample_out is not None:
+        desc["resample_out"] = list(resample_out)
+    if pad_canvas is not None:
+        desc["pad_canvas"] = list(pad_canvas)
+    desc["filter"] = plan.filter_method
+    return desc
+
+
+class ProgramHandle:
+    """One device program: callable like the jitted function it wraps,
+    but compiled through the AOT API so its XLA cost analysis feeds the
+    per-plan cost ledger.
+
+    The first call lowers and compiles (``jit(...).lower(*args)
+    .compile()``) — the AOT and call-time compile caches are disjoint in
+    this jax, so the handle *owns* the compile and every later call runs
+    the compiled executable directly (same one-compile-per-shape
+    semantics as calling the jit; the lru caches in build_program /
+    build_batched_program key the shapes). The compiled object exposes
+    ``cost_analysis()``/``memory_analysis()``, which the call-time path
+    discards — FLOPs, bytes accessed, peak memory, and the measured
+    compile wall time are recorded in the ledger keyed by this handle's
+    program key. Any AOT-path failure (backend quirk) falls back to
+    calling the jitted function forever after, recording a ledger entry
+    with nulled cost fields — cost accounting must never fail a render
+    (tests/test_costledger.py pins the fallback).
+    """
+
+    __slots__ = (
+        "_jitted", "_compiled", "_fallback", "_lock",
+        "ledger_key", "descriptor",
+    )
+
+    def __init__(self, jitted, key, descriptor: Dict[str, object]) -> None:
+        self._jitted = jitted
+        self._compiled = None
+        self._fallback = False
+        self._lock = threading.Lock()
+        if isinstance(key, str):
+            self.ledger_key = key
+        else:
+            _ledger()  # populate the lazy module ref
+            self.ledger_key = _costledger_mod.key_digest(key)
+        self.descriptor = descriptor
+
+    @property
+    def is_compiled(self) -> bool:
+        """True once this handle holds a compiled program (or settled on
+        the jitted fallback) — the batcher's EXACT compile-hit signal,
+        replacing the old lru-miss-count inference."""
+        return self._compiled is not None or self._fallback
+
+    def __call__(self, *args):
+        compiled = self._compiled
+        if compiled is not None:
+            return compiled(*args)
+        if self._fallback:
+            return self._jitted(*args)
+        with self._lock:
+            # double-checked: a concurrent first call compiled while we
+            # waited — run it below, outside the lock
+            if self._compiled is None and not self._fallback:
+                self._compile(args)
+            compiled = self._compiled
+        if compiled is not None:
+            return compiled(*args)
+        return self._jitted(*args)
+
+    def _compile(self, args) -> None:
+        """AOT-compile for ``args``'s shapes and record the cost ledger
+        entry (caller holds the handle lock; contention is only ever
+        concurrent *first* calls of one program, which would all block
+        on the same XLA compile anyway)."""
+        ledger = _ledger()  # also populates the lazy module ref the
+        # cost-normalization below reads
+        t0 = time.perf_counter()
+        try:
+            compiled = self._jitted.lower(*args).compile()
+        except Exception:
+            # the jitted call path is the behavior of record; anything
+            # the AOT path cannot handle falls back to it, uncosted
+            self._fallback = True
+            ledger.record_compile(
+                self.ledger_key,
+                descriptor=self.descriptor,
+                compile_s=None,
+                cost=None,
+                peak_memory_bytes=None,
+                fallback=True,
+            )
+            return
+        compile_s = time.perf_counter() - t0
+        cost = None
+        try:
+            cost = _costledger_mod.normalize_cost_analysis(
+                compiled.cost_analysis()
+            )
+        except Exception:
+            cost = None  # backend raised: entry keeps nulled cost fields
+        peak = None
+        try:
+            mem = compiled.memory_analysis()
+            if mem is not None:
+                peak = float(
+                    getattr(mem, "argument_size_in_bytes", 0)
+                    + getattr(mem, "output_size_in_bytes", 0)
+                    + getattr(mem, "temp_size_in_bytes", 0)
+                )
+        except Exception:
+            peak = None
+        self._compiled = compiled
+        ledger.record_compile(
+            self.ledger_key,
+            descriptor=self.descriptor,
+            compile_s=compile_s,
+            cost=cost,
+            peak_memory_bytes=peak,
+        )
+
+
 @lru_cache(maxsize=256)
 def build_program(
     in_shape: Tuple[int, int],
@@ -162,14 +327,61 @@ def build_program(
     pad_canvas: Optional[Tuple[int, int]],
     pad_offset: Tuple[int, int],
     plan: TransformPlan,
-):
-    """Compile (lazily, via jit) the device program for one op config at one
-    padded input shape. Callers must pass ``plan.device_plan()`` so the
+) -> ProgramHandle:
+    """Compile (lazily, on first call) the device program for one op
+    config at one padded input shape, as a ``ProgramHandle`` feeding the
+    per-plan cost ledger. Callers must pass ``plan.device_plan()`` so the
     cache key ignores per-image geometry (it arrives as traced spans).
-    ``in_shape`` keys the cache — the jit itself re-specializes per input
-    shape, but keeping it in the key keeps cache entries one-shape."""
-    del in_shape
-    return jax.jit(make_program_fn(resample_out, pad_canvas, pad_offset, plan))
+    ``in_shape`` keys the cache — one handle per input shape keeps each
+    handle single-shape, which is what lets it hold ONE compiled
+    executable."""
+    key = ("single", in_shape, resample_out, pad_canvas, pad_offset, plan)
+    return ProgramHandle(
+        jax.jit(make_program_fn(resample_out, pad_canvas, pad_offset, plan)),
+        key,
+        plan_descriptor(
+            plan, in_shape=in_shape, resample_out=resample_out,
+            pad_canvas=pad_canvas,
+        ),
+    )
+
+
+def program_cache_info() -> Dict[str, object]:
+    """Introspection over BOTH program caches (this module's single-image
+    cache and the batcher's batched cache) — the source of truth the
+    compile-hit accounting and the ``flyimg_program_cache_entries`` gauge
+    read, instead of inferring state from miss-count deltas."""
+    single = build_program.cache_info()
+    doc = {
+        "single": {
+            "entries": single.currsize,
+            "hits": single.hits,
+            "misses": single.misses,
+            "maxsize": single.maxsize,
+        },
+    }
+    try:
+        from flyimg_tpu.runtime.batcher import build_batched_program
+
+        batched = build_batched_program.cache_info()
+        doc["batched"] = {
+            "entries": batched.currsize,
+            "hits": batched.hits,
+            "misses": batched.misses,
+            "maxsize": batched.maxsize,
+        }
+    except Exception:
+        doc["batched"] = None
+    return doc
+
+
+def program_cache_entries() -> float:
+    """Total live entries across both program caches (the gauge fn)."""
+    info = program_cache_info()
+    total = info["single"]["entries"]
+    if info.get("batched"):
+        total += info["batched"]["entries"]
+    return float(total)
 
 
 def final_extent(plan: TransformPlan, layout: Layout) -> Tuple[int, int]:
@@ -247,6 +459,7 @@ def run_plan(image: np.ndarray, plan: TransformPlan) -> np.ndarray:
         layout.pad_offset,
         plan.device_plan(),
     )
+    t0 = time.perf_counter()
     out = fn(
         jnp.asarray(padded),
         jnp.array([h, w], jnp.float32),
@@ -255,6 +468,11 @@ def run_plan(image: np.ndarray, plan: TransformPlan) -> np.ndarray:
         jnp.array(layout.out_true, jnp.float32),
     )
     result = np.asarray(out)
+    # single-image launches count in the per-plan ledger too (the CPU
+    # fallback / library path must not be invisible to attribution)
+    _ledger().record_launch(
+        fn.ledger_key, device_s=time.perf_counter() - t0, images=1
+    )
     if slice_out is not None:
         result = np.ascontiguousarray(result[: slice_out[0], : slice_out[1]])
     return result

@@ -56,13 +56,15 @@ import jax.numpy as jnp
 import numpy as np
 
 from flyimg_tpu.ops.compose import (
+    ProgramHandle,
     _bucket_dim,
     bucket_batch,
     final_extent,
     make_program_fn,
+    plan_descriptor,
     plan_layout,
 )
-from flyimg_tpu.runtime import tracing
+from flyimg_tpu.runtime import costledger, tracing
 from flyimg_tpu.runtime.resilience import (
     POISON,
     TRANSIENT,
@@ -121,24 +123,42 @@ def build_batched_program(
     plan: TransformPlan,
     mesh=None,
     rotate_dynamic: bool = False,
-):
+) -> ProgramHandle:
     """vmap of the single-image program over a static batch axis; with a
     mesh, the batch axis is sharded over its 'data' axis (SPMD fan-out, no
-    collectives — each device transforms its slice of the batch)."""
-    del batch_size, in_shape  # cache-key components; jit re-specializes
+    collectives — each device transforms its slice of the batch). Returned
+    as a ``ProgramHandle``: the first call AOT-compiles and records XLA
+    cost analysis in the per-plan ledger; ``handle.is_compiled`` is the
+    batcher's exact compile-hit signal. One cache entry = one (batch,
+    shape) program = one compiled executable."""
     inner = make_program_fn(
         resample_out, pad_canvas, pad_offset, plan,
         rotate_dynamic=rotate_dynamic,
     )
     if mesh is None:
-        return jax.jit(jax.vmap(inner))
-    from jax.sharding import NamedSharding, PartitionSpec as P
+        jitted = jax.jit(jax.vmap(inner))
+    else:
+        from jax.sharding import NamedSharding, PartitionSpec as P
 
-    sharding = NamedSharding(mesh, P("data"))
-    return jax.jit(
-        jax.vmap(inner),
-        in_shardings=(sharding,) * 5,
-        out_shardings=sharding,
+        sharding = NamedSharding(mesh, P("data"))
+        jitted = jax.jit(
+            jax.vmap(inner),
+            in_shardings=(sharding,) * 5,
+            out_shardings=sharding,
+        )
+    key = (
+        "batched", batch_size, in_shape, resample_out, pad_canvas,
+        pad_offset, plan, rotate_dynamic,
+        tuple(mesh.shape.items()) if mesh is not None else None,
+    )
+    return ProgramHandle(
+        jitted,
+        key,
+        plan_descriptor(
+            plan, in_shape=in_shape, batch=batch_size,
+            resample_out=resample_out, pad_canvas=pad_canvas,
+            rotate_dynamic=rotate_dynamic,
+        ),
     )
 
 
@@ -201,6 +221,8 @@ class BatchController:
         bisect_enable: bool = True,
         quarantine_ttl_s: float = 0.0,
         executor_wedge_timeout_s: float = 0.0,
+        flight_recorder=None,
+        profiler=None,
     ) -> None:
         from flyimg_tpu.runtime.metrics import (
             MetricsRegistry,
@@ -225,6 +247,15 @@ class BatchController:
         # single source of truth for batch accounting; the app passes its
         # shared registry, standalone use gets a private one
         self.metrics = metrics if metrics is not None else MetricsRegistry()
+        # performance observatory wiring (all optional; None = zero-cost):
+        # the batch flight recorder (runtime/flightrecorder.py) gets one
+        # record per launch resolution; the on-demand profiler
+        # (runtime/profiling.py) is poked around every device dispatch;
+        # the per-plan cost ledger (process-wide singleton) accrues
+        # device seconds per program key
+        self.flight_recorder = flight_recorder
+        self.profiler = profiler
+        self._ledger = costledger.get_ledger()
         # admission control: "pending" = submitted and not yet resolved
         # (queued OR executing). When the bound is hit, submit sheds with
         # a 503 + Retry-After instead of queueing into collapse; 0 keeps
@@ -843,6 +874,47 @@ class BatchController:
         )
         return span_obj
 
+    @staticmethod
+    def _flight_plan_key(group: _Group, fn=None) -> Optional[str]:
+        """The flight-recorder's plan identity for one launch: the
+        program handle's ledger key (joins /debug/plans) for transform
+        launches, an ``aux:<runner>`` tag for auxiliary batches."""
+        if group.runner is not None:
+            return f"aux:{getattr(group.runner, '__name__', 'aux')}"
+        return fn.ledger_key if fn is not None else None
+
+    def _record_flight(self, group: _Group, members: List[_Pending], *,
+                       n: int, batch: int, seq: Optional[int],
+                       queue_wait_s: float, fn=None,
+                       h2d_s: Optional[float] = None,
+                       dispatch_s: Optional[float] = None,
+                       sync_s: Optional[float] = None,
+                       device_s: Optional[float] = None,
+                       compile_hit: Optional[bool] = None,
+                       kind: str = "primary",
+                       error: Optional[str] = None) -> None:
+        """One flight-recorder entry per launch resolution (primary,
+        recovery, aux, and failures alike). No recorder wired -> one
+        None check; the record itself is a dict append."""
+        if self.flight_recorder is None:
+            return
+        self.flight_recorder.record(
+            controller=self.name,
+            batch_id=seq,
+            plan_key=self._flight_plan_key(group, fn),
+            occupancy=n,
+            capacity=batch,
+            queue_wait_s=queue_wait_s,
+            h2d_s=h2d_s,
+            dispatch_s=dispatch_s,
+            sync_s=sync_s,
+            device_s=device_s,
+            compile_hit=compile_hit,
+            kind=kind,
+            trace_id=self._member_trace_id(members),
+            error=error,
+        )
+
     def _execute(self, group: _Group):
         """Run one popped group. Returns True when the batch was handed
         off to a drain thread (it stays registered in
@@ -914,6 +986,10 @@ class BatchController:
                     compile_hit=None,
                     trace_id=self._member_trace_id(members), aux=True,
                 )
+                self._record_flight(
+                    group, members, n=n, batch=n, seq=seq,
+                    queue_wait_s=queue_wait_s, device_s=aux_s, kind="aux",
+                )
                 if span_obj is not None:
                     span_obj.end()
                     self._attach_batch_span(members, span_obj)
@@ -927,9 +1003,16 @@ class BatchController:
                     )
                     span_obj.end("error")
                     self._attach_batch_span(members, span_obj)
+                self._record_flight(
+                    group, members, n=n, batch=n, seq=seq,
+                    queue_wait_s=queue_wait_s, kind="aux",
+                    error=type(exc).__name__,
+                )
                 self._recover(group, members, exc)
             return
         span_obj = None
+        batch, fn, compile_hit = n, None, None
+        profiler_poked = False
         try:
             batch, arrays = self._assemble(group, members)
             fn, compile_hit = self._program(group, batch)
@@ -954,16 +1037,26 @@ class BatchController:
             inflight.acquire()
             self._touch_busy()
             try:
-                # asynchronous dispatch: returns once the launch is
-                # enqueued; pixels land later, read on a drain thread.
-                # The TraceAnnotation labels the launch in jax.profiler
-                # device traces (/debug/trace) so profiler timelines and
-                # request traces share the batch id.
+                # split device accounting (satellite of the performance
+                # observatory): host->device transfer, asynchronous
+                # dispatch (returns once the launch is enqueued; pixels
+                # land later, read on a drain thread), and the
+                # readback-side sync measured in _drain. The
+                # TraceAnnotation labels the launch in jax.profiler
+                # device traces (/debug/trace, /debug/profile) so
+                # profiler timelines and request traces share batch ids.
+                if self.profiler is not None:
+                    self.profiler.on_batch_start()
+                    profiler_poked = True
+                t_h2d = time.perf_counter()
+                dev_args = [jnp.asarray(a) for a in arrays]
                 t_dispatch = time.perf_counter()
+                h2d_s = t_dispatch - t_h2d
                 if not compile_hit:
                     self._suspend_busy()  # synchronous XLA compile ahead
                 with jax.profiler.TraceAnnotation(f"flyimg:batch:{seq}"):
-                    dev_out = fn(*(jnp.asarray(a) for a in arrays))
+                    dev_out = fn(*dev_args)
+                dispatch_s = time.perf_counter() - t_dispatch
                 self._touch_busy()  # dispatch returned: progress
                 # the batch was registered in _inflight_batches by _run
                 # BEFORE dispatch (close()-drain visibility); ownership
@@ -973,6 +1066,7 @@ class BatchController:
                     args=(
                         group, members, dev_out, n, batch, t_dispatch,
                         span_obj, inflight, queue_wait_s, compile_hit,
+                        fn, seq, h2d_s, dispatch_s,
                     ),
                     name="flyimg-batcher-drain",
                     daemon=True,
@@ -982,6 +1076,11 @@ class BatchController:
                 inflight.release()
                 raise
         except Exception as exc:
+            if profiler_poked:
+                # a failed dispatch never reaches _drain's finally — the
+                # armed capture's batch budget must still decrement or
+                # the trace runs to the watchdog deadline
+                self.profiler.on_batch_end()
             if span_obj is not None and span_obj.duration_s is None:
                 # dispatch failed after the span was minted: the errored
                 # span must still reach the member traces (tail sampling
@@ -991,6 +1090,11 @@ class BatchController:
                 )
                 span_obj.end("error")
                 self._attach_batch_span(members, span_obj)
+            self._record_flight(
+                group, members, n=n, batch=batch, seq=seq,
+                queue_wait_s=queue_wait_s, fn=fn, compile_hit=compile_hit,
+                error=type(exc).__name__,
+            )
             self._recover(group, members, exc)
 
     def _assemble(self, group: _Group, members: List[_Pending]):
@@ -1050,12 +1154,13 @@ class BatchController:
         return batch, (images, in_true, span_y, span_x, out_true)
 
     def _program(self, group: _Group, batch: int):
-        """Resolve the jitted batched program for one launch.
-        An lru miss here means a NEW batched program was built — its
-        first call is the XLA compile (possibly served from the
-        persistent compilation cache, still the expensive path); a hit
-        reuses an already-jitted callable."""
-        misses_before = build_batched_program.cache_info().misses
+        """Resolve the batched program handle for one launch. The
+        compile hit/miss comes from the HANDLE itself
+        (``ProgramHandle.is_compiled`` — has this program's executable
+        been built yet), not from lru miss-count deltas: the old
+        inference mis-labeled launches when concurrent recovery launches
+        raced the counter read, and said nothing about a cache-evicted
+        handle that will recompile on its next call."""
         fn = build_batched_program(
             batch,
             group.in_shape,
@@ -1066,9 +1171,7 @@ class BatchController:
             self.mesh,
             group.rotate_dynamic,
         )
-        compile_hit = (
-            build_batched_program.cache_info().misses == misses_before
-        )
+        compile_hit = fn.is_compiled
         self.metrics.record_compile_event(compile_hit)
         return fn, compile_hit
 
@@ -1097,14 +1200,22 @@ class BatchController:
                t_dispatch: Optional[float] = None, span_obj=None,
                inflight: Optional[threading.Semaphore] = None,
                queue_wait_s: float = 0.0,
-               compile_hit: Optional[bool] = None) -> None:
+               compile_hit: Optional[bool] = None,
+               fn=None, seq: Optional[int] = None,
+               h2d_s: Optional[float] = None,
+               dispatch_s: Optional[float] = None) -> None:
         """Blocking device->host read + future resolution for one
         dispatched batch (runs on a daemon drain thread). ``inflight`` is
         the pipeline semaphore instance this batch acquired from (the
-        live one unless wedge self-healing swapped it since)."""
+        live one unless wedge self-healing swapped it since).
+        ``h2d_s``/``dispatch_s`` are the launch-side halves of the device
+        split measured in ``_execute``; the readback sync is timed here,
+        and ``flyimg_device_seconds`` keeps its meaning as the total."""
         try:
             faults.fire("batcher.drain", key=group.key, n=n, batch=batch)
+            t_sync = time.perf_counter()
             out = np.asarray(dev_out)
+            sync_s = time.perf_counter() - t_sync
             trace_id = self._member_trace_id(members)
             device_s = (
                 time.perf_counter() - t_dispatch
@@ -1117,17 +1228,42 @@ class BatchController:
                 self.metrics.record_device_batch_seconds(
                     device_s, trace_id=trace_id
                 )
+            self.metrics.record_device_split(
+                h2d_s=h2d_s, dispatch_s=dispatch_s, sync_s=sync_s,
+                trace_id=trace_id,
+            )
+            if fn is not None and device_s is not None:
+                # per-plan attribution: cumulative device seconds against
+                # the program key the cost ledger costed at compile time
+                self._ledger.record_launch(
+                    fn.ledger_key, device_s=device_s, images=n
+                )
             if span_obj is not None:
                 span_obj.end()
                 if device_s is not None:
                     span_obj.set_attribute(
                         "device.seconds", round(device_s, 6)
                     )
+                # the split rides the SHARED span into every member
+                # trace (and the Server-Timing header derives from it)
+                if h2d_s is not None:
+                    span_obj.set_attribute("device.h2d_s", round(h2d_s, 6))
+                if dispatch_s is not None:
+                    span_obj.set_attribute(
+                        "device.dispatch_s", round(dispatch_s, 6)
+                    )
+                span_obj.set_attribute("device.sync_s", round(sync_s, 6))
                 self._attach_batch_span(members, span_obj)
             self.metrics.record_batch_launch(
                 self.name, images=n, capacity=batch,
                 queue_wait_s=queue_wait_s, device_s=device_s,
                 compile_hit=compile_hit, trace_id=trace_id,
+            )
+            self._record_flight(
+                group, members, n=n, batch=batch, seq=seq,
+                queue_wait_s=queue_wait_s, fn=fn, h2d_s=h2d_s,
+                dispatch_s=dispatch_s, sync_s=sync_s, device_s=device_s,
+                compile_hit=compile_hit,
             )
             self._resolve_members(group, members, out)
         except Exception as exc:
@@ -1139,8 +1275,16 @@ class BatchController:
                 )
                 span_obj.end("error")
                 self._attach_batch_span(members, span_obj)
+            self._record_flight(
+                group, members, n=n, batch=batch, seq=seq,
+                queue_wait_s=queue_wait_s, fn=fn, h2d_s=h2d_s,
+                dispatch_s=dispatch_s, compile_hit=compile_hit,
+                error=type(exc).__name__,
+            )
             self._recover(group, members, exc)
         finally:
+            if self.profiler is not None:
+                self.profiler.on_batch_end()
             (inflight if inflight is not None else self._inflight).release()
             with self._lock:
                 if members in self._inflight_batches:
@@ -1321,21 +1465,50 @@ class BatchController:
                 device_s=aux_s, compile_hit=None,
                 trace_id=self._member_trace_id(members), aux=True,
             )
+            self._record_flight(
+                group, members, n=n, batch=n, seq=seq,
+                queue_wait_s=queue_wait_s, device_s=aux_s, kind="recovery",
+            )
             return outputs
         batch, arrays = self._assemble(group, members)
         fn, compile_hit = self._program(group, batch)
         if not compile_hit:
             self._suspend_busy()  # synchronous XLA compile ahead
+        if self.profiler is not None:
+            self.profiler.on_batch_start()
+        t_h2d = time.perf_counter()
+        dev_args = [jnp.asarray(a) for a in arrays]
         t_dispatch = time.perf_counter()
+        h2d_s = t_dispatch - t_h2d
         with jax.profiler.TraceAnnotation(f"flyimg:batch:{seq}"):
-            dev_out = fn(*(jnp.asarray(a) for a in arrays))
+            dev_out = fn(*dev_args)
+        dispatch_s = time.perf_counter() - t_dispatch
         self._touch_busy()  # dispatch returned: progress
-        faults.fire("batcher.drain", key=group.key, n=n, batch=batch)
-        out = np.asarray(dev_out)
+        try:
+            faults.fire("batcher.drain", key=group.key, n=n, batch=batch)
+            t_sync = time.perf_counter()
+            out = np.asarray(dev_out)
+            sync_s = time.perf_counter() - t_sync
+        finally:
+            if self.profiler is not None:
+                self.profiler.on_batch_end()
+        device_s = time.perf_counter() - t_dispatch
+        trace_id = self._member_trace_id(members)
+        self.metrics.record_device_split(
+            h2d_s=h2d_s, dispatch_s=dispatch_s, sync_s=sync_s,
+            trace_id=trace_id,
+        )
+        self._ledger.record_launch(
+            fn.ledger_key, device_s=device_s, images=n
+        )
         self.metrics.record_batch_launch(
             self.name, images=n, capacity=batch, queue_wait_s=queue_wait_s,
-            device_s=time.perf_counter() - t_dispatch,
-            compile_hit=compile_hit,
-            trace_id=self._member_trace_id(members),
+            device_s=device_s, compile_hit=compile_hit, trace_id=trace_id,
+        )
+        self._record_flight(
+            group, members, n=n, batch=batch, seq=seq,
+            queue_wait_s=queue_wait_s, fn=fn, h2d_s=h2d_s,
+            dispatch_s=dispatch_s, sync_s=sync_s, device_s=device_s,
+            compile_hit=compile_hit, kind="recovery",
         )
         return out

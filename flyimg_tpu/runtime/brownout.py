@@ -144,6 +144,13 @@ class BrownoutEngine:
         self._last_pressure = 0.0
         self._last_components: Dict[str, float] = {}
         self._transitions_total = 0
+        # escalation listeners (service/app.py wires the flight
+        # recorder's dump here): queued inside _transition_locked,
+        # FIRED after the engine lock is released in evaluate() — a
+        # listener doing file IO under this lock would convoy every
+        # request that rides an evaluation
+        self._transition_listeners = []
+        self._pending_notifications = []
         # signal sources (attach() below); all optional
         self._batchers: Tuple = ()
         self._slo = None
@@ -185,6 +192,13 @@ class BrownoutEngine:
         )
 
     # -- signal wiring -----------------------------------------------------
+
+    def add_transition_listener(self, listener) -> None:
+        """Register a callback fired on every ESCALATION (level up),
+        outside the engine lock, with ``{from, to, pressure}``. The
+        serving wiring dumps the batch flight recorder here — the ring
+        still holds the launches that built the pressure."""
+        self._transition_listeners.append(listener)
 
     def attach(self, *, batchers=(), slo=None, inflight_fn=None,
                breaker_open_fn=None) -> None:
@@ -299,6 +313,11 @@ class BrownoutEngine:
             return NORMAL
         injected = faults.fire("brownout.signal")
         now = self._clock()
+        level = self._evaluate_locked_region(injected, now)
+        self._flush_notifications()
+        return level
+
+    def _evaluate_locked_region(self, injected, now: float) -> int:
         with self._lock:
             if (
                 injected is faults.PASS
@@ -340,6 +359,22 @@ class BrownoutEngine:
                     )
             return self._level
 
+    def _flush_notifications(self) -> None:
+        """Fire queued escalation notifications OUTSIDE the engine lock
+        (listeners do file IO — the flight-recorder dump)."""
+        with self._lock:
+            pending, self._pending_notifications = (
+                self._pending_notifications, []
+            )
+        for doc in pending:
+            for listener in self._transition_listeners:
+                try:
+                    listener(doc)
+                except Exception:
+                    logging.getLogger(BROWNOUT_LOGGER).warning(
+                        "brownout transition listener failed", exc_info=True
+                    )
+
     def _transition_locked(self, to: int, pressure: float,
                            since: float) -> None:
         """Move to ``to``; ``since`` is the new level's start time —
@@ -365,6 +400,15 @@ class BrownoutEngine:
             to=name,
             pressure=round(pressure, 4),
         )
+        if to > frm and self._transition_listeners:
+            # escalations notify listeners (queued; evaluate() fires
+            # them after this lock is released)
+            self._pending_notifications.append({
+                "event": "brownout.escalation",
+                "from": LEVEL_NAMES[frm],
+                "to": name,
+                "pressure": round(pressure, 4),
+            })
         log = logging.getLogger(BROWNOUT_LOGGER)
         log_fn = log.warning if to > frm else log.info
         log_fn(

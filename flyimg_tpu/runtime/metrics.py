@@ -276,6 +276,82 @@ class BatchEfficiency:
         }
 
 
+class PoolUtilization:
+    """Rolling busy-ratio tracker for one host worker pool (the decode /
+    encode codec pools). ``track()`` wraps each pool call; the gauge
+    callback reads ``busy_ratio()`` — summed busy time overlapping the
+    trailing window, divided by the window. Concurrent callers stack, so
+    a ratio above 1.0 means the pool is oversubscribed (more wall-clock
+    demand than one serial pool can supply) — exactly the saturation
+    signal the host-codec pipelined-DAG work (ROADMAP item 4) needs to
+    start from a measurement instead of a guess."""
+
+    def __init__(self, window_s: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self.window_s = max(float(window_s), 0.1)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._intervals: deque = deque()  # (start, end) monotonic pairs
+
+    def track(self):
+        """Context manager around ONE pool call."""
+        return _PoolTrack(self)
+
+    def _record(self, start: float, end: float) -> None:
+        with self._lock:
+            self._intervals.append((start, end))
+            self._prune_locked(end)
+
+    def _prune_locked(self, now: float) -> None:
+        horizon = now - self.window_s
+        while self._intervals and self._intervals[0][1] < horizon:
+            self._intervals.popleft()
+
+    def busy_ratio(self) -> float:
+        now = self._clock()
+        horizon = now - self.window_s
+        with self._lock:
+            self._prune_locked(now)
+            busy = sum(
+                min(end, now) - max(start, horizon)
+                for start, end in self._intervals
+            )
+        return max(busy, 0.0) / self.window_s
+
+
+class _PoolTrack:
+    __slots__ = ("_pool", "_t0")
+
+    def __init__(self, pool: PoolUtilization) -> None:
+        self._pool = pool
+
+    def __enter__(self):
+        self._t0 = self._pool._clock()
+        return self
+
+    def __exit__(self, *exc):
+        self._pool._record(self._t0, self._pool._clock())
+        return False
+
+
+# process-wide host-pool trackers (like the native pools they watch —
+# one decode pool per process, whatever the app count); apps export them
+# through flyimg_host_pool_busy_ratio gauge callbacks (service/app.py)
+_host_pools: Dict[str, PoolUtilization] = {}
+_host_pools_lock = threading.Lock()
+
+
+def host_pool(name: str) -> PoolUtilization:
+    """Get-or-create the utilization tracker for one host pool
+    ('decode' / 'encode'; flyimg_tpu/codecs wraps its pool calls)."""
+    with _host_pools_lock:
+        pool = _host_pools.get(name)
+        if pool is None:
+            pool = PoolUtilization()
+            _host_pools[name] = pool
+        return pool
+
+
 class MetricsRegistry:
     """Named metric store; one per app."""
 
@@ -379,6 +455,40 @@ class MetricsRegistry:
             seconds,
             trace_id=trace_id if self.exemplars_enabled else None,
         )
+
+    def record_device_split(
+        self,
+        *,
+        h2d_s: Optional[float] = None,
+        dispatch_s: Optional[float] = None,
+        sync_s: Optional[float] = None,
+        trace_id: Optional[str] = None,
+    ) -> None:
+        """The per-launch device-time split (runtime/batcher.py): host->
+        device transfer and device->host readback sync land in
+        ``flyimg_device_transfer_seconds{direction=}``, the asynchronous
+        dispatch (enqueue; includes the synchronous XLA compile on a
+        miss) in ``flyimg_device_dispatch_seconds``.
+        ``flyimg_device_seconds`` keeps its meaning as the total —
+        these are its components, recorded per launch so the round-4
+        dispatch/readback transport constants stay visible separately."""
+        exemplar = trace_id if self.exemplars_enabled else None
+        if h2d_s is not None:
+            self.histogram(
+                'flyimg_device_transfer_seconds{direction="h2d"}',
+                "Host<->device transfer time per batch launch, by direction",
+            ).observe(max(float(h2d_s), 0.0), trace_id=exemplar)
+        if dispatch_s is not None:
+            self.histogram(
+                "flyimg_device_dispatch_seconds",
+                "Asynchronous dispatch (launch enqueue) time per batch; "
+                "includes the synchronous XLA compile on a miss",
+            ).observe(max(float(dispatch_s), 0.0), trace_id=exemplar)
+        if sync_s is not None:
+            self.histogram(
+                'flyimg_device_transfer_seconds{direction="d2h"}',
+                "Host<->device transfer time per batch launch, by direction",
+            ).observe(max(float(sync_s), 0.0), trace_id=exemplar)
 
     def record_compile_event(self, cache_hit: bool) -> None:
         """Batched-program compile cache outcome per device batch."""
@@ -626,6 +736,16 @@ class MetricsRegistry:
         if slo is not None and getattr(slo, "enabled", False):
             for key, value in slo.summary_fields().items():
                 out[f"slo:{key}"] = value
+        # per-plan cost ledger aggregates (runtime/costledger.py): the
+        # same attribution vocabulary /debug/plans serves, folded in so
+        # bulk sweeps and bench artifacts carry FLOP/byte accounting
+        try:
+            from flyimg_tpu.runtime.costledger import get_ledger
+
+            for key, value in get_ledger().aggregates().items():
+                out[f"plan_ledger:{key}"] = value
+        except Exception:
+            pass  # accounting must never fail a summary
         return out
 
     def perf_snapshot(self) -> Dict[str, object]:

@@ -114,6 +114,10 @@ class SloEngine:
         self._breached = False
         self._breaches_total = 0
         self._last_breach: Optional[Dict[str, object]] = None
+        # breach listeners (service/app.py wires the batch flight
+        # recorder's dump here): called OUTSIDE the engine lock, once
+        # per edge-triggered breach, with the breach document
+        self._breach_listeners: List[Callable[[Dict[str, object]], None]] = []
 
     @classmethod
     def from_params(cls, params, *, metrics=None,
@@ -187,6 +191,15 @@ class SloEngine:
                 },
             )
 
+    def add_breach_listener(
+        self, listener: Callable[[Dict[str, object]], None]
+    ) -> None:
+        """Register a callback fired once per edge-triggered breach
+        (after the log/span/counter emission, outside the engine lock).
+        The serving wiring uses this to dump the batch flight recorder
+        at the moment the evidence is still in the ring."""
+        self._breach_listeners.append(listener)
+
     def _emit_breach(self, fast: float, slow: float, trace) -> None:
         """Edge-triggered breach emission: one structured log line + a
         span event on the triggering trace (kept by the tail sampler —
@@ -225,6 +238,21 @@ class SloEngine:
                 "trace_id": trace_id,
             },
         )
+        doc = {
+            "event": "slo.breach",
+            "burn_rate_fast": round(fast, 3),
+            "burn_rate_slow": round(slow, 3),
+            "trace_id": trace_id,
+        }
+        for listener in self._breach_listeners:
+            try:
+                listener(doc)
+            except Exception:
+                # a broken listener must not fail the request that
+                # happened to tip the breach
+                logging.getLogger(SLO_LOGGER).warning(
+                    "SLO breach listener failed", exc_info=True
+                )
 
     # -- window bookkeeping (caller holds the lock) ------------------------
 

@@ -341,7 +341,7 @@ def add_event(name: str, **attrs) -> None:
 _ST_NAME_RE = re.compile(r"[^a-zA-Z0-9_-]+")
 
 
-def server_timing(trace: Trace, max_entries: int = 12) -> str:
+def server_timing(trace: Trace, max_entries: int = 16) -> str:
     """Flatten one finished trace into a ``Server-Timing`` header value:
     per-stage durations (fetch/decode/batch_wait/device/encode/...) in
     first-seen order, same-name spans summed (the two storage spans), the
@@ -354,6 +354,13 @@ def server_timing(trace: Trace, max_entries: int = 12) -> str:
     order: List[str] = []
     with trace._lock:
         spans = list(trace.spans)
+
+    def _add(name: str, seconds: float) -> None:
+        if name not in durations:
+            order.append(name)
+            durations[name] = 0.0
+        durations[name] += seconds
+
     for span_obj in spans[1:]:  # [0] is the root, reported as `total`
         if span_obj.duration_s is None:
             continue
@@ -361,10 +368,19 @@ def server_timing(trace: Trace, max_entries: int = 12) -> str:
             "device" if span_obj.name == "device_execute" else span_obj.name
         )
         name = _ST_NAME_RE.sub("_", name)
-        if name not in durations:
-            order.append(name)
-            durations[name] = 0.0
-        durations[name] += span_obj.duration_s
+        _add(name, span_obj.duration_s)
+        if span_obj.name == "device_execute":
+            # the batcher's h2d / dispatch / readback-sync split rides
+            # the shared span as attributes; surface it next to the
+            # total so a bare curl shows where device time went
+            for attr, st_name in (
+                ("device.h2d_s", "device_h2d"),
+                ("device.dispatch_s", "device_dispatch"),
+                ("device.sync_s", "device_sync"),
+            ):
+                value = span_obj.attributes.get(attr)
+                if isinstance(value, (int, float)):
+                    _add(st_name, float(value))
     parts = [
         f"{name};dur={durations[name] * 1000.0:.2f}"
         for name in order[:max_entries]

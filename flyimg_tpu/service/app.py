@@ -13,7 +13,10 @@ plus the observability surface (docs/observability.md): /metrics,
 shutdown), and — debug-gated — /debug/trace (jax.profiler capture),
 /debug/traces (tail-sampled trace ring), /debug/traces/{id} (span tree),
 /debug/slo (burn rates / error budget), /debug/perf (batch efficiency),
-/debug/brownout (degradation level + pressure components).
+/debug/plans (per-plan XLA cost ledger), /debug/flightrecorder (the
+per-launch ring + dump inventory), /debug/profile (arm/list/download
+batch-scoped device-profile captures), /debug/brownout (degradation
+level + pressure components).
 
 plus the ``encrypt`` CLI subcommand (reference app.php:93-96):
 
@@ -157,6 +160,26 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
     slo = SloEngine.from_params(params, metrics=metrics)
     slo.register_metrics(metrics)
     metrics.attach_slo(slo)
+    # performance observatory (docs/observability.md): the per-plan XLA
+    # cost ledger (process-wide, like the program caches it mirrors),
+    # the batch flight recorder, and the on-demand device profiler
+    from flyimg_tpu.runtime.costledger import get_ledger
+    from flyimg_tpu.runtime.flightrecorder import FlightRecorder
+    from flyimg_tpu.runtime.profiling import DeviceProfiler
+
+    cost_ledger = get_ledger()
+    cost_ledger.configure(
+        max_entries=int(params.by_key("costledger_max_entries", 256))
+    )
+    cost_ledger.register_metrics(metrics)
+    flight_recorder = FlightRecorder.from_params(params, metrics=metrics)
+    profiler = DeviceProfiler.from_params(params, metrics=metrics)
+    # the automatic dump triggers: the PR-4 SLO breach event and the
+    # PR-5 brownout escalation hook — both fire while the evidence (the
+    # launches that built the burn/pressure) is still in the ring
+    slo.add_breach_listener(
+        lambda info: flight_recorder.dump("slo_breach", context=info)
+    )
     debug_enabled = bool(params.by_key("debug"))
     log_access = bool(params.by_key("log_access", True))
     storage = make_storage(params, metrics=metrics)
@@ -240,6 +263,8 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
         max_queue_depth=int(params.by_key("batch_max_queue_depth", 0)),
         shed_retry_after_s=shed_retry_after,
         name="device",
+        flight_recorder=flight_recorder,
+        profiler=profiler,
         **containment,
     )
     # host codec work gets its OWN controller/thread: JPEG-miss decode
@@ -251,6 +276,7 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
         max_queue_depth=int(params.by_key("decode_max_queue_depth", 0)),
         shed_retry_after_s=shed_retry_after,
         name="codec",
+        flight_recorder=flight_recorder,
         **containment,
     )
     # fault-injection hook (flyimg_tpu/testing/faults.py): tests assemble
@@ -278,6 +304,15 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
 
     brownout = BrownoutEngine.from_params(params, metrics=metrics)
     brownout.register_metrics(metrics)
+    # flight-recorder wiring: records carry the live brownout level, and
+    # every escalation dumps the ring (the launches that built the
+    # pressure are the evidence an operator wants afterwards)
+    flight_recorder.attach(level_fn=brownout.level)
+    brownout.add_transition_listener(
+        lambda info: flight_recorder.dump(
+            "brownout_escalation", context=info
+        )
+    )
     handler = ImageHandler(
         storage, params, batcher=batcher, codec_batcher=codec_batcher,
         face_backend=face_backend, metrics=metrics, sp_mesh=sp_mesh,
@@ -296,6 +331,31 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
         "flyimg_traces_buffered",
         "Traces held in the tail-sampling ring buffer",
         fn=lambda: len(tracer),
+    )
+    # program-cache truth (ops/compose.py program_cache_entries): the
+    # gauge behind the exact compile-hit accounting, replacing the old
+    # miss-count inference (docs/observability.md)
+    from flyimg_tpu.ops.compose import program_cache_entries
+
+    metrics.gauge(
+        "flyimg_program_cache_entries",
+        "Live entries across the single-image and batched program caches",
+        fn=program_cache_entries,
+    )
+    # host codec utilization (runtime/metrics.py PoolUtilization; the
+    # codec layer wraps its pool calls): busy-ratio over the trailing
+    # window, >1.0 = oversubscribed stage
+    from flyimg_tpu.runtime.metrics import host_pool
+
+    metrics.gauge(
+        'flyimg_host_pool_busy_ratio{pool="decode"}',
+        "Host codec pool busy-time share over the trailing window",
+        fn=lambda: host_pool("decode").busy_ratio(),
+    )
+    metrics.gauge(
+        'flyimg_host_pool_busy_ratio{pool="encode"}',
+        "Host codec pool busy-time share over the trailing window",
+        fn=lambda: host_pool("encode").busy_ratio(),
     )
     # the engine's pressure sources: batcher queue depth + efficiency
     # window, SLO burn rates, the inflight gauge, breaker-open count
@@ -618,6 +678,12 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
             return web.Response(status=400, text="ms must be a positive number")
         if trace_lock.locked():
             return web.Response(status=409, text="a trace is already running")
+        if profiler.busy:
+            # the batch-scoped profiler (/debug/profile) and this
+            # wall-clock capture share the ONE global jax profiler
+            return web.Response(
+                status=409, text="a /debug/profile capture is in flight"
+            )
         trace_dir = _os.path.join(
             str(params.by_key("tmp_dir", "var/tmp")), "traces",
             time.strftime("%Y%m%d-%H%M%S"),
@@ -696,6 +762,123 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
             content_type="application/json",
         )
 
+    async def debug_plans(_request: web.Request) -> web.Response:
+        """Per-plan cost ledger: FLOPs / bytes accessed / peak device
+        memory / compile wall time / cumulative device seconds keyed by
+        program, plus program-cache introspection (runtime/costledger.py
+        snapshot; docs/observability.md "Per-plan cost ledger")."""
+        import json as _json
+
+        denied = _debug_gate_404()
+        if denied is not None:
+            return denied
+        from flyimg_tpu.ops.compose import program_cache_info
+
+        doc = cost_ledger.snapshot()
+        doc["program_cache"] = program_cache_info()
+        return web.Response(
+            text=_json.dumps(doc),
+            content_type="application/json",
+        )
+
+    async def debug_flightrecorder(_request: web.Request) -> web.Response:
+        """Batch flight recorder: the live per-launch ring + the dump
+        inventory (runtime/flightrecorder.py snapshot;
+        docs/observability.md "Batch flight recorder")."""
+        import json as _json
+
+        denied = _debug_gate_404()
+        if denied is not None:
+            return denied
+        return web.Response(
+            text=_json.dumps(flight_recorder.snapshot()),
+            content_type="application/json",
+        )
+
+    async def debug_profile_get(_request: web.Request) -> web.Response:
+        """On-demand profiler state + completed captures
+        (runtime/profiling.py; docs/observability.md "On-demand device
+        profiling")."""
+        import json as _json
+
+        denied = _debug_gate_404()
+        if denied is not None:
+            return denied
+        return web.Response(
+            text=_json.dumps(profiler.snapshot()),
+            content_type="application/json",
+        )
+
+    async def debug_profile_arm(request: web.Request) -> web.Response:
+        """Arm a device-profile capture of the next N batches
+        (?batches=N, ?max_s=S; bounded by the profiling_* knobs). One
+        concurrent capture; 409 while one is armed or running."""
+        import json as _json
+
+        denied = _debug_gate_404()
+        if denied is not None:
+            return denied
+        if trace_lock.locked():
+            # the wall-clock /debug/trace capture owns the one global
+            # jax profiler right now (it already 409s in the other
+            # direction while this profiler is busy)
+            return web.Response(
+                status=409, text="a /debug/trace capture is running"
+            )
+        try:
+            batches = int(request.query.get("batches", 4))
+            max_s = (
+                float(request.query["max_s"])
+                if "max_s" in request.query else None
+            )
+            if batches <= 0 or (max_s is not None and not max_s > 0):
+                raise ValueError
+        except ValueError:
+            return web.Response(
+                status=400,
+                text="batches (int > 0) and max_s (seconds > 0) expected",
+            )
+        try:
+            state = profiler.arm(batches, max_s)
+        except RuntimeError as exc:
+            return web.Response(status=409, text=str(exc))
+        return web.Response(
+            text=_json.dumps(state), content_type="application/json"
+        )
+
+    async def debug_profile_download(request: web.Request) -> web.Response:
+        """Download one completed capture as a tar.gz (names come from
+        the capture listing — an unlisted name is a 404, so a crafted
+        path segment cannot escape the capture dir)."""
+        import io as _io
+        import tarfile as _tarfile
+
+        denied = _debug_gate_404()
+        if denied is not None:
+            return denied
+        name = request.match_info["name"]
+        path = profiler.capture_path(name)
+        if path is None:
+            return web.Response(status=404, text="no such capture")
+        loop = asyncio.get_running_loop()
+
+        def _pack() -> bytes:
+            buf = _io.BytesIO()
+            with _tarfile.open(fileobj=buf, mode="w:gz") as tar:
+                tar.add(path, arcname=name)
+            return buf.getvalue()
+
+        blob = await loop.run_in_executor(None, _pack)
+        return web.Response(
+            body=blob,
+            headers={
+                "Content-Type": "application/gzip",
+                "Content-Disposition": (
+                    f'attachment; filename="{name}.tar.gz"'
+                ),
+            },
+        )
+
     async def debug_brownout(_request: web.Request) -> web.Response:
         """Brownout engine state: level, pressure components, thresholds,
         refresh-queue occupancy (runtime/brownout.py snapshot;
@@ -738,6 +921,13 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
     app.router.add_get("/debug/traces/{trace_id}", debug_traces_get)
     app.router.add_get("/debug/slo", debug_slo)
     app.router.add_get("/debug/perf", debug_perf)
+    app.router.add_get("/debug/plans", debug_plans)
+    app.router.add_get("/debug/flightrecorder", debug_flightrecorder)
+    app.router.add_get("/debug/profile", debug_profile_get)
+    app.router.add_post("/debug/profile", debug_profile_arm)
+    app.router.add_get(
+        "/debug/profile/captures/{name}", debug_profile_download
+    )
     app.router.add_get("/debug/brownout", debug_brownout)
     # Route table is config-overridable like the reference's
     # config/routes.yml (RoutesResolver.php); imageSrc uses a catch-all

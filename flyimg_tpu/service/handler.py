@@ -814,6 +814,14 @@ class ImageHandler:
                 decode_span.set_attribute("decode.mime", data_info.mime)
                 decode_span.set_attribute("decode.batched", batched_decode)
         timings["decode"] = time.perf_counter() - t
+        if self.metrics is not None:
+            # host-codec throughput accounting (the codec-overhaul
+            # baseline, ROADMAP item 4): compressed bytes in, next to
+            # the decode-pool busy-ratio gauge
+            self.metrics.counter(
+                "flyimg_decode_bytes_total",
+                "Compressed source bytes through the host decode stage",
+            ).inc(len(data))
 
         w, h = decoded.size
         plan = build_plan(options, w, h)
@@ -1077,6 +1085,11 @@ class ImageHandler:
             if encode_span is not None:
                 encode_span.set_attribute("encode.bytes", len(content))
         timings["encode"] = time.perf_counter() - t
+        if self.metrics is not None:
+            self.metrics.counter(
+                "flyimg_encode_bytes_total",
+                "Encoded output bytes through the host encode stage",
+            ).inc(len(content))
 
         # rf_1 debug header payload (reference `identify` line via the
         # im-identify header, Response.php:62 + Processor.php:71-77),

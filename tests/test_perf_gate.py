@@ -1,7 +1,9 @@
 """Perf-regression gate: comparison math, calibration normalization,
 attribution on failure, and the end-to-end self-test — an unmodified
 tree passes, a fault-injected device slowdown fails with the device
-stage named (ISSUE 4 acceptance)."""
+stage named (ISSUE 4 acceptance) — plus the schema-2 per-plan cost
+gate: deterministic FLOP/byte figures compared WITHOUT host scaling,
+failing on an injected FLOP regression (ISSUE 7 acceptance)."""
 
 import json
 import os
@@ -15,12 +17,23 @@ sys.path.insert(
 import perf_gate  # noqa: E402
 
 
-def _doc(stages_ms, calibration_ms=5.0):
-    return {
+def _doc(stages_ms, calibration_ms=5.0, plan_cost=None):
+    doc = {
         "schema": 1,
         "repeats": 3,
         "calibration_ms": calibration_ms,
         "stages": {k: {"median_ms": v} for k, v in stages_ms.items()},
+    }
+    if plan_cost is not None:
+        doc["schema"] = 2
+        doc["plan_cost"] = plan_cost
+    return doc
+
+
+def _cost(flops=1.0e7, bytes_total=2.0e6):
+    return {
+        "programs": 2, "flops_total": flops, "bytes_total": bytes_total,
+        "plans": {},
     }
 
 
@@ -82,6 +95,105 @@ def test_compare_reports_missing_stage():
     assert ok  # missing is surfaced, not a regression verdict
 
 
+def test_compare_flags_flop_regression_without_host_scaling():
+    """A 2x FLOP jump fails even on a host whose calibration says
+    everything runs 2x slower — cost is a program property, not a host
+    property, so NO calibration scaling applies."""
+    ok, report = perf_gate.compare(
+        _doc(BASE, calibration_ms=5.0, plan_cost=_cost()),
+        _doc({k: v * 2 for k, v in BASE.items()}, calibration_ms=10.0,
+             plan_cost=_cost(flops=2.0e7)),
+        tolerance=1.5, cost_tolerance=1.2,
+    )
+    assert not ok
+    row = next(
+        r for r in report["cost_rows"] if r["field"] == "flops_total"
+    )
+    assert row["verdict"] == "REGRESSED"
+    assert row["ratio"] == pytest.approx(2.0)
+    bytes_row = next(
+        r for r in report["cost_rows"] if r["field"] == "bytes_total"
+    )
+    assert bytes_row["verdict"] == "ok"
+
+
+def test_compare_cost_within_band_passes():
+    ok, report = perf_gate.compare(
+        _doc(BASE, plan_cost=_cost()),
+        _doc(BASE, plan_cost=_cost(flops=1.1e7)),
+        tolerance=1.5, cost_tolerance=1.2,
+    )
+    assert ok, report
+
+
+def test_compare_schema1_baseline_reports_cost_missing_not_failing():
+    """Backward compatibility: a schema-1 baseline (no plan_cost) stays
+    checkable — cost rows surface as `missing`, never as regressions."""
+    ok, report = perf_gate.compare(
+        _doc(BASE),                                  # schema-1
+        _doc(BASE, plan_cost=_cost()),
+        tolerance=1.5,
+    )
+    assert ok
+    assert all(
+        r["verdict"] == "missing" for r in report["cost_rows"]
+    )
+    # and the symmetric case: costed baseline, uncosted current (the
+    # backend-returned-nothing case) must not fail either
+    ok, report = perf_gate.compare(
+        _doc(BASE, plan_cost=_cost()),
+        _doc(BASE, plan_cost={"programs": 0, "flops_total": None,
+                              "bytes_total": None, "plans": {}}),
+        tolerance=1.5,
+    )
+    assert ok
+    assert all(
+        r["verdict"] == "missing" for r in report["cost_rows"]
+    )
+
+
+def test_parse_inject_cost_grammar():
+    assert perf_gate._parse_inject_cost("flops=3.0") == pytest.approx(3.0)
+    with pytest.raises(SystemExit):
+        perf_gate._parse_inject_cost("bytes=2.0")
+
+
+@pytest.mark.slow
+def test_gate_cost_self_test_injected_flop_regression_fails(tmp_path):
+    """ISSUE 7 acceptance: --check fails on an injected FLOP regression.
+    Runs in a SUBPROCESS so the measure sees a fresh process-wide cost
+    ledger (the suite's programs must be newly compiled to be costed)."""
+    import subprocess
+    import sys as _sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    baseline = tmp_path / "baseline.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def run(*extra):
+        return subprocess.run(
+            [_sys.executable, os.path.join(repo, "tools", "perf_gate.py"),
+             "--repeats", "3", "--warmup", "1",
+             "--baseline", str(baseline), *extra],
+            cwd=repo, env=env, capture_output=True, text=True, timeout=300,
+        )
+
+    update = run("--update")
+    assert update.returncode == 0, update.stderr
+    doc = json.loads(baseline.read_text())
+    assert doc["schema"] == 2
+    assert doc["plan_cost"]["flops_total"] and \
+        doc["plan_cost"]["flops_total"] > 0
+    check = run("--check", "--tolerance", "8.0")
+    assert check.returncode == 0, check.stdout + check.stderr
+    injected = run(
+        "--check", "--tolerance", "8.0", "--inject-cost", "flops=3.0"
+    )
+    assert injected.returncode == 1, injected.stdout + injected.stderr
+    assert "flops_total" in injected.stdout
+    assert "REGRESSED" in injected.stdout
+
+
 @pytest.mark.slow
 def test_gate_end_to_end_pass_then_injected_fail(tmp_path):
     """The acceptance self-test: measure -> self-baseline -> --check
@@ -110,3 +222,10 @@ def test_measure_produces_all_stages_quick():
         doc["stages"][s]["median_ms"] >= 0 for s in perf_gate.STAGES
     )
     assert doc["calibration_ms"] > 0
+    # schema 2 carries the per-plan cost snapshot; in a shared test
+    # process the suite's programs may already be ledgered (the diff is
+    # empty -> nulled totals, the documented non-failing case)
+    assert doc["schema"] == 2
+    assert "plan_cost" in doc
+    flops = doc["plan_cost"]["flops_total"]
+    assert flops is None or flops > 0

@@ -208,6 +208,58 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+async def _scrape_observability(client: httpx.AsyncClient, base: str):
+    """End-of-run attribution scrape: batch efficiency (/debug/perf),
+    the per-plan cost ledger (/debug/plans), and the flight-recorder
+    summary (/debug/flightrecorder) — so BENCH_r06+ artifacts carry
+    per-plan FLOP/byte/occupancy attribution next to throughput, not
+    just throughput. Returns None per section when the target serves
+    404 (debug off — e.g. --base against a production config)."""
+
+    async def _get(path):
+        try:
+            resp = await client.get(f"{base}{path}")
+            if resp.status_code != 200:
+                return None
+            return resp.json()
+        except (httpx.HTTPError, ValueError):
+            return None
+
+    perf = await _get("/debug/perf")
+    plans = await _get("/debug/plans")
+    recorder = await _get("/debug/flightrecorder")
+    plan_costs = None
+    if plans is not None:
+        rows = plans.get("plans", [])
+        plan_costs = {
+            "aggregates": plans.get("aggregates"),
+            # the top device-time consumers, compact: enough to attribute
+            # a sweep without embedding the whole ledger per row
+            "top_plans": [
+                {
+                    "key": row["key"],
+                    "ops": (row.get("descriptor") or {}).get("ops"),
+                    "batch": (row.get("descriptor") or {}).get("batch"),
+                    "flops": row.get("flops"),
+                    "bytes_accessed": row.get("bytes_accessed"),
+                    "launches": row.get("launches"),
+                    "device_s": row.get("device_s"),
+                }
+                for row in rows[:8]
+            ],
+        }
+    return {
+        "batch_efficiency": (
+            (perf or {}).get("controllers") if perf is not None else None
+        ),
+        "device": (perf or {}).get("device") if perf is not None else None,
+        "plan_costs": plan_costs,
+        "flightrecorder": (
+            recorder.get("summary") if recorder is not None else None
+        ),
+    }
+
+
 async def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--base", default=None, help="base URL of a running service")
@@ -251,6 +303,8 @@ async def main() -> int:
     store = None
     base = args.base
     if base is None:
+        import tempfile
+
         port = _free_port()
         base = f"http://127.0.0.1:{port}"
         spawn_cmd = [
@@ -258,13 +312,19 @@ async def main() -> int:
             "--port", str(port),
         ]
         if args.fresh_storage:
-            import tempfile
-
             store = tempfile.mkdtemp(prefix="flyimg-bench-store-")
-            params_path = os.path.join(store, "params.yml")
-            with open(params_path, "w") as fh:
+            params_dir = store
+        else:
+            params_dir = tempfile.mkdtemp(prefix="flyimg-bench-params-")
+        # spawned services always run with debug on: the end-of-run
+        # attribution scrape (/debug/perf, /debug/plans,
+        # /debug/flightrecorder) is the point of a bench artifact
+        params_path = os.path.join(params_dir, "params.yml")
+        with open(params_path, "w") as fh:
+            fh.write("debug: true\n")
+            if store is not None:
                 fh.write(f"upload_dir: {os.path.join(store, 'out')}\n")
-            spawn_cmd += ["--params", params_path]
+        spawn_cmd += ["--params", params_path]
         proc = subprocess.Popen(
             spawn_cmd,
             stdout=subprocess.DEVNULL,
@@ -292,6 +352,7 @@ async def main() -> int:
 
             print(f"target {base}  rate {args.rate} req/s x {args.duration}s "
                   f"+ burst {args.burst} @ conc {args.conc}")
+            all_rows = []
             for name, options in SCENARIOS:
                 url = f"{base}/upload/{options}/{src}"
                 warm = await client.get(url)   # first miss computes
@@ -309,12 +370,14 @@ async def main() -> int:
                 lat, fails, elapsed = await _rated_run(
                     client, [url] * int(args.rate * args.duration), args.rate
                 )
-                _report(name, "rated", lat, fails, elapsed)
+                all_rows.append(_report(name, "rated", lat, fails, elapsed))
                 if args.burst:
                     lat, fails, elapsed = await _burst_run(
                         client, url, args.burst, args.conc
                     )
-                    _report(name, "burst", lat, fails, elapsed)
+                    all_rows.append(
+                        _report(name, "burst", lat, fails, elapsed)
+                    )
 
             if args.miss:
                 # distinct sources (same dims -> one shape bucket) so every
@@ -337,7 +400,9 @@ async def main() -> int:
                 lat, fails, elapsed = await _miss_run(
                     client, urls[args.miss_warm:], args.conc
                 )
-                _report("miss", "burst", lat, fails, elapsed)
+                all_rows.append(
+                    _report("miss", "burst", lat, fails, elapsed)
+                )
 
             if args.miss_rates:
                 rates = [float(r) for r in args.miss_rates.split(",")]
@@ -398,27 +463,48 @@ async def main() -> int:
                         row["offered_rate_rps"] = rate
                         row["options"] = vopts
                         sweep.append(row)
-                if args.miss_out:
-                    with open(args.miss_out, "w") as fh:
-                        json.dump({
-                            "what": (
-                                "RATED (open-loop) cache-MISS latency vs "
-                                "offered rate; every request is a distinct "
-                                "uncoalescible key through the full "
-                                "fetch/decode/device/encode miss pipeline"
-                            ),
-                            "method": (
-                                f"{args.duration}s per rate per encoder "
-                                "variant; vegeta-style fixed schedule; "
-                                "service and client share this host"
-                            ),
-                            "backend": os.environ.get(
-                                "JAX_PLATFORMS", "default"
-                            ),
-                            "rows": sweep,
-                        }, fh, indent=1)
-                        fh.write("\n")
-                    print(f"wrote {args.miss_out}")
+                        all_rows.append(row)
+
+            # end-of-run attribution: batch efficiency + per-plan cost +
+            # flight-recorder summary embedded in every row (and the
+            # sweep artifact), so BENCH_r06+ carries attribution, not
+            # just throughput. None sections = target served 404
+            # (debug off).
+            obs = await _scrape_observability(client, base)
+            if obs is not None and any(v is not None for v in obs.values()):
+                for row in all_rows:
+                    row["batch_efficiency"] = obs["batch_efficiency"]
+                    row["plan_costs"] = obs["plan_costs"]
+                    row["flightrecorder"] = obs["flightrecorder"]
+                print(json.dumps({"observability": obs}))
+            elif args.base:
+                print(
+                    "note: target serves no /debug endpoints (debug off) — "
+                    "rows carry no batch-efficiency/plan-cost attribution",
+                    file=sys.stderr,
+                )
+
+            if args.miss_rates and args.miss_out:
+                with open(args.miss_out, "w") as fh:
+                    json.dump({
+                        "what": (
+                            "RATED (open-loop) cache-MISS latency vs "
+                            "offered rate; every request is a distinct "
+                            "uncoalescible key through the full "
+                            "fetch/decode/device/encode miss pipeline"
+                        ),
+                        "method": (
+                            f"{args.duration}s per rate per encoder "
+                            "variant; vegeta-style fixed schedule; "
+                            "service and client share this host"
+                        ),
+                        "backend": os.environ.get(
+                            "JAX_PLATFORMS", "default"
+                        ),
+                        "rows": sweep,
+                    }, fh, indent=1)
+                    fh.write("\n")
+                print(f"wrote {args.miss_out}")
     finally:
         if proc is not None:
             proc.send_signal(signal.SIGTERM)

@@ -24,6 +24,17 @@ CPU backend, sequential submits (every batch is a lone flush).
 the ``batcher.execute`` point — the self-test proving the gate actually
 fails when a stage gets slower (tests/test_perf_gate.py runs it).
 
+The baseline also carries the **per-plan cost snapshot** (schema 2): the
+XLA cost ledger's FLOPs / bytes-accessed totals for the programs the
+micro-suite compiles (runtime/costledger.py — the same figures
+``/debug/plans`` serves). Latency bands absorb host noise; the cost
+figures are *deterministic* for one jax version, so a kernel change that
+silently multiplies device FLOPs fails ``--check`` even when this CPU
+host can't see the latency difference — exactly the gate the
+banded-resample promotion (ROADMAP item 1) is judged by.
+``--inject-cost flops=3.0`` is the matching self-test: it scales the
+measured FLOPs and must fail the gate.
+
 CI: the ``perf-gate`` job runs ``--check`` with wide, CI-noise-tolerant
 bands (see .github/workflows/ci.yml). Baseline refresh policy:
 benchmarks/README.md.
@@ -46,11 +57,14 @@ DEFAULT_BASELINE = os.path.join(
     REPO_ROOT, "benchmarks", "perf_baseline.json"
 )
 STAGES = ("decode", "device", "encode", "total", "cache_hit")
+# per-plan cost figures gated alongside the latency stages (schema 2);
+# cost analysis is deterministic per jax version, so its band is tight
+COST_FIELDS = ("flops_total", "bytes_total")
 # absolute per-stage slack added on top of the relative band: sub-ms
 # stages on shared runners jitter by fractions of a ms that no relative
 # band should be asked to absorb
 ABS_SLACK_MS = 2.0
-SCHEMA = 1
+SCHEMA = 2
 
 
 def _calibrate(rounds: int = 5) -> float:
@@ -83,11 +97,24 @@ def _parse_inject(spec: str):
     return stage, float(seconds)
 
 
+def _parse_inject_cost(spec: str) -> float:
+    """'flops=3.0' -> multiply the measured FLOP total — the self-test
+    proving an injected cost regression FAILS the gate (the cost-side
+    twin of --inject's latency spike)."""
+    field, _, factor = spec.partition("=")
+    if field.strip() != "flops":
+        raise SystemExit(
+            f"--inject-cost supports 'flops=<factor>' (got {spec!r})"
+        )
+    return float(factor)
+
+
 def measure(repeats: int = 30, warmup: int = 3,
-            inject: str | None = None) -> dict:
+            inject: str | None = None,
+            inject_cost: str | None = None) -> dict:
     """Run the micro-suite; returns {stages: {name: {median_ms}},
-    calibration_ms, repeats}. Import-heavy work happens here so --help
-    stays instant."""
+    plan_cost: {...}, calibration_ms, repeats}. Import-heavy work happens
+    here so --help stays instant."""
     from flyimg_tpu.parallel.mesh import ensure_env_platform
 
     ensure_env_platform()
@@ -113,12 +140,19 @@ def measure(repeats: int = 30, warmup: int = 3,
     batcher = BatchController(max_batch=8, deadline_ms=0.5)
     handler = ImageHandler(storage, params, batcher=batcher)
 
+    from flyimg_tpu.runtime.costledger import get_ledger
+
     injector = None
     if inject:
         stage, seconds = _parse_inject(inject)
         injector = faults.FaultInjector()
         injector.plan("batcher.execute", faults.latency_spike(seconds))
         faults.install(injector)
+    cost_factor = _parse_inject_cost(inject_cost) if inject_cost else 1.0
+
+    # per-plan cost snapshot: diff the ledger around the run so only the
+    # programs THIS suite compiles count (the ledger is process-wide)
+    keys_before = {row["key"] for row in get_ledger().entries()}
 
     rng = np.random.default_rng(20260803)
     source = rng.integers(0, 255, (96, 128, 3), dtype=np.uint8)
@@ -162,6 +196,35 @@ def measure(repeats: int = 30, warmup: int = 3,
             faults.clear()
         batcher.close()
 
+    # the suite's per-plan cost snapshot (XLA cost analysis from the
+    # ledger entries the run created): deterministic per jax version —
+    # what makes a FLOP regression gateable on a noisy CPU host. Nulled
+    # (and not gated) when the backend returned no cost analysis.
+    suite_rows = [
+        row for row in get_ledger().entries()
+        if row["key"] not in keys_before and row["costed"]
+    ]
+    plan_cost = {
+        "programs": len(suite_rows),
+        "flops_total": (
+            sum(row["flops"] for row in suite_rows) * cost_factor
+            if suite_rows else None
+        ),
+        "bytes_total": (
+            sum(row["bytes_accessed"] or 0.0 for row in suite_rows)
+            * cost_factor
+            if suite_rows else None
+        ),
+        "plans": {
+            row["key"]: {
+                "flops": row["flops"],
+                "bytes_accessed": row["bytes_accessed"],
+                "descriptor": row["descriptor"],
+            }
+            for row in suite_rows
+        },
+    }
+
     return {
         "schema": SCHEMA,
         "repeats": repeats,
@@ -174,14 +237,21 @@ def measure(repeats: int = 30, warmup: int = 3,
             }
             for stage, values in rows.items()
         },
+        "plan_cost": plan_cost,
     }
 
 
 def compare(baseline: dict, current: dict, tolerance: float,
-            abs_slack_ms: float = ABS_SLACK_MS):
+            abs_slack_ms: float = ABS_SLACK_MS,
+            cost_tolerance: float = 1.2):
     """-> (ok, report_rows). A stage regresses when its current median
     exceeds ``baseline * scale * tolerance + abs_slack_ms`` where
-    ``scale`` is the host-calibration ratio (current / baseline hosts)."""
+    ``scale`` is the host-calibration ratio (current / baseline hosts).
+    Per-plan cost fields (schema 2) regress on
+    ``current > baseline * cost_tolerance`` — NO host scaling: FLOPs and
+    bytes are properties of the compiled programs, not the host. A
+    schema-1 baseline (or an uncosted backend) reports the cost rows as
+    ``missing`` without failing, so old baselines stay checkable."""
     cal_base = float(baseline.get("calibration_ms") or 0.0)
     cal_now = float(current.get("calibration_ms") or 0.0)
     scale = (cal_now / cal_base) if cal_base > 0 and cal_now > 0 else 1.0
@@ -209,8 +279,32 @@ def compare(baseline: dict, current: dict, tolerance: float,
             "allowed_ms": round(allowed, 4),
             "verdict": "REGRESSED" if regressed else "ok",
         })
+    cost_rows = []
+    base_cost = baseline.get("plan_cost") or {}
+    cur_cost = current.get("plan_cost") or {}
+    for field in COST_FIELDS:
+        base = base_cost.get(field)
+        cur = cur_cost.get(field)
+        if base is None or cur is None or base <= 0:
+            cost_rows.append({
+                "field": field, "verdict": "missing",
+                "baseline": base, "current": cur,
+            })
+            continue
+        ratio = cur / base
+        regressed = cur > base * cost_tolerance
+        ok = ok and not regressed
+        cost_rows.append({
+            "field": field,
+            "baseline": base,
+            "current": cur,
+            "ratio": round(ratio, 3),
+            "allowed": round(base * cost_tolerance, 2),
+            "verdict": "REGRESSED" if regressed else "ok",
+        })
     return ok, {"scale": round(scale, 4), "tolerance": tolerance,
-                "rows": rows}
+                "cost_tolerance": cost_tolerance, "rows": rows,
+                "cost_rows": cost_rows}
 
 
 def _print_report(report: dict, ok: bool) -> None:
@@ -233,14 +327,28 @@ def _print_report(report: dict, ok: bool) -> None:
             f"{row['ratio']:>6.2f}x {row['allowed_ms']:>9.2f}m  "
             f"{row['verdict']}"
         )
+    for row in report.get("cost_rows", []):
+        if row["verdict"] == "missing":
+            print(f"cost {row['field']:<12} missing "
+                  "(schema-1 baseline or uncosted backend)")
+            continue
+        print(
+            f"cost {row['field']:<12} {row['baseline']:.3e} -> "
+            f"{row['current']:.3e} ({row['ratio']}x, allowed "
+            f"{row['allowed']:.3e})  {row['verdict']}"
+        )
     if ok:
         print("perf gate: PASS")
     else:
         slowest = [
             r for r in report["rows"] if r.get("verdict") == "REGRESSED"
+        ] + [
+            r for r in report.get("cost_rows", [])
+            if r.get("verdict") == "REGRESSED"
         ]
         attribution = ", ".join(
-            f"{r['stage']} {r['ratio']}x over scaled baseline"
+            f"{r.get('stage') or r.get('field')} {r['ratio']}x over "
+            "baseline"
             for r in slowest
         )
         print(f"perf gate: FAIL — {attribution}")
@@ -280,13 +388,25 @@ def main(argv=None) -> int:
              "fails on a real slowdown",
     )
     ap.add_argument(
+        "--inject-cost", default=None, metavar="FIELD=FACTOR",
+        help="multiply the measured plan-cost figures (flops=3.0) to "
+             "prove the gate fails on a FLOP regression",
+    )
+    ap.add_argument(
+        "--cost-tolerance", type=float,
+        default=float(defaults.by_key("perf_gate_cost_tolerance", 1.2)),
+        help="relative band for the per-plan FLOP/byte figures (no host "
+             "scaling — cost analysis is deterministic per jax version)",
+    )
+    ap.add_argument(
         "--json", action="store_true",
         help="also print the full current measurement as one JSON line",
     )
     ns = ap.parse_args(argv)
 
     current = measure(
-        repeats=ns.repeats, warmup=ns.warmup, inject=ns.inject
+        repeats=ns.repeats, warmup=ns.warmup, inject=ns.inject,
+        inject_cost=ns.inject_cost,
     )
     if ns.json:
         print(json.dumps(current))
@@ -309,7 +429,10 @@ def main(argv=None) -> int:
         return 2
     with open(ns.baseline) as fh:
         baseline = json.load(fh)
-    ok, report = compare(baseline, current, ns.tolerance)
+    ok, report = compare(
+        baseline, current, ns.tolerance,
+        cost_tolerance=ns.cost_tolerance,
+    )
     _print_report(report, ok)
     return 0 if ok else 1
 

@@ -187,6 +187,71 @@ async def main() -> int:
         )
         _require("decode" in perf_doc["stages"], "/debug/perf stage rows")
 
+        # per-plan cost ledger: the render compiled one device program;
+        # its entry must be COSTED (CPU XLA provides cost analysis) and
+        # carry cumulative device seconds for its one launch
+        plans_doc = await (await client.get("/debug/plans")).json()
+        costed = [
+            row for row in plans_doc["plans"]
+            if row["costed"] and row["launches"] >= 1
+        ]
+        _require(bool(costed), "/debug/plans costed+launched entry")
+        row = costed[0]
+        _require(row["flops"] and row["flops"] > 0, "plan flops")
+        _require(
+            row["bytes_accessed"] and row["bytes_accessed"] > 0,
+            "plan bytes accessed",
+        )
+        _require(row["compile_s"] is not None, "plan compile wall time")
+        _require(row["device_s"] > 0, "plan cumulative device seconds")
+        _require(
+            plans_doc["program_cache"]["batched"]["entries"] >= 1,
+            "program cache introspection",
+        )
+
+        # flight recorder: the render's launch is in the ring with the
+        # h2d/dispatch/sync device split and an exact compile-miss flag
+        fr_doc = await (await client.get("/debug/flightrecorder")).json()
+        _require(
+            fr_doc["summary"]["records"] >= 1, "flight-recorder records"
+        )
+        launch = fr_doc["records"][0]
+        for field in ("h2d_s", "dispatch_s", "sync_s", "device_s"):
+            _require(
+                launch[field] is not None and launch[field] >= 0,
+                f"flight-recorder {field}",
+            )
+        _require(
+            launch["compile_hit"] is False,
+            "first launch recorded as a compile miss",
+        )
+        _require(
+            launch["plan_key"] == row["key"],
+            "flight-recorder launch joins the cost-ledger entry",
+        )
+
+        # profiler surface: status doc serves; double-arm answers 409
+        prof_doc = await (await client.get("/debug/profile")).json()
+        _require(prof_doc["armed"] is False, "/debug/profile status")
+        armed = await client.post("/debug/profile?batches=1")
+        _require(armed.status == 200, f"profiler arm {armed.status}")
+        second = await client.post("/debug/profile?batches=1")
+        _require(second.status == 409, "second arm rejected 409")
+
+        # the split also reaches /metrics and the Server-Timing header
+        _require(
+            "flyimg_device_transfer_seconds_bucket" in metrics_text,
+            "device transfer split histogram",
+        )
+        _require(
+            "flyimg_plan_entries" in metrics_text, "plan ledger gauge"
+        )
+        server_timing = resp.headers.get("Server-Timing", "")
+        _require(
+            "device_dispatch;dur=" in server_timing,
+            f"Server-Timing device split ({server_timing!r})",
+        )
+
         # the trace is retrievable and its span tree is well-formed
         detail = await client.get(f"/debug/traces/{tid}")
         _require(detail.status == 200, f"trace lookup {detail.status}")
@@ -204,9 +269,66 @@ async def main() -> int:
             f"observability smoke OK: {n_spans} spans, "
             f"{len(names)} metric families, retry event present"
         )
-        return 0
     finally:
         await client.close()
+
+    # --- leg 2: debug OFF + forced SLO breach -------------------------
+    # (a) the perf-observatory endpoints must 404 (not 403, not serve);
+    # (b) a breach must STILL dump the flight recorder to disk — the
+    # dump is an incident artifact, not a debug-gated nicety. The
+    # breach is forced by an impossible latency objective: the first
+    # pipeline request is "slow", and one slow request in an otherwise
+    # empty window burns the whole budget (documented PR-4 behavior).
+    dump_dir = os.path.join(tmp, "fr-dumps")
+    params2 = AppParameters(
+        {
+            "tmp_dir": os.path.join(tmp, "t2"),
+            "upload_dir": os.path.join(tmp, "u2"),
+            "debug": False,
+            "batch_deadline_ms": 1.0,
+            "slo_latency_p99_ms": 0.001,
+            "flightrecorder_dump_dir": dump_dir,
+        }
+    )
+    app2 = make_app(params2)
+    client2 = TestClient(TestServer(app2))
+    await client2.start_server()
+    try:
+        src_path = os.path.join(tmp, "smoke-local.png")
+        with open(src_path, "wb") as fh:
+            fh.write(png)
+        resp = await client2.get(f"/upload/w_20,h_16,o_png/{src_path}")
+        _require(resp.status == 200, f"leg-2 render {resp.status}")
+        for path in ("/debug/plans", "/debug/flightrecorder",
+                     "/debug/profile"):
+            gated = await client2.get(path)
+            _require(
+                gated.status == 404, f"{path} is 404 with debug off"
+            )
+        armed = await client2.post("/debug/profile?batches=1")
+        _require(
+            armed.status == 404, "/debug/profile POST is 404 with debug off"
+        )
+        import glob
+        import json as _json
+
+        dumps = glob.glob(os.path.join(dump_dir, "flightrecorder-*.json"))
+        _require(bool(dumps), "forced SLO breach wrote a flight-recorder dump")
+        with open(dumps[0]) as fh:
+            doc = _json.load(fh)
+        _require(doc["reason"] == "slo_breach", "dump reason")
+        _require(
+            doc["summary"]["records"] >= 1 and doc["records"],
+            "dump carries launch records",
+        )
+        print(
+            "observability smoke OK (leg 2): debug endpoints 404, breach "
+            f"dump {os.path.basename(dumps[0])} with "
+            f"{doc['summary']['records']} records"
+        )
+        return 0
+    finally:
+        await client2.close()
 
 
 if __name__ == "__main__":
