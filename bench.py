@@ -239,10 +239,16 @@ def _supervise() -> None:
     # even CPU failed: still emit the one promised JSON line, but exit
     # nonzero — a dead bench must not look like a pass to rc-checking
     # callers (chip_suite keeps the stdout tail either way)
+    # sanitize like ops/resample.py's env seed does, so this failure row
+    # reports the mode a child would actually have run
+    kern = os.environ.get("FLYIMG_RESAMPLE_KERNEL", "dense")
+    if kern not in ("dense", "banded", "auto"):
+        kern = "dense"
     _emit_final(json.dumps({
         "metric": "images/sec/chip resize(300x250 crop-fill)+smart-crop",
         "value": 0.0, "unit": "images/sec", "vs_baseline": 0.0,
         "backend": "none", "error": f"bench child failed (rc={rc})",
+        "kernel": kern,
     }))
     sys.exit(1)
 
@@ -442,6 +448,8 @@ def main() -> None:
     else:
         per_batch = dt / (2 * SCAN_LEN)
     images_per_sec = BATCH / per_batch
+    from flyimg_tpu.ops.resample import kernel_mode
+
     print(
         json.dumps(
             {
@@ -450,6 +458,10 @@ def main() -> None:
                 "unit": "images/sec",
                 "vs_baseline": round(images_per_sec / TARGET_PER_CHIP, 3),
                 "backend": backend,
+                # which resample-kernel variant set this headline
+                # (bench_history.jsonl must be able to tell a banded
+                # record from a dense one; docs/kernels.md)
+                "kernel": kernel_mode(),
             }
         )
     )

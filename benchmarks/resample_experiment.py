@@ -60,7 +60,13 @@ def build(small: bool = False):
     import jax
     import jax.numpy as jnp
 
-    from flyimg_tpu.ops.resample import resample_image, resample_matrix
+    from flyimg_tpu.ops.resample import (
+        band_taps,
+        bucket_taps,
+        resample_image,
+        resample_image_banded,
+        resample_matrix,
+    )
 
     # CPU smoke shrinks the geometry too: a 512^2 f32 resample is seconds
     # per image on one host core
@@ -109,44 +115,24 @@ def build(small: bool = False):
         ).reshape(oh, 3, ow)
         return jnp.transpose(out, (0, 2, 1))
 
+    # The dense [out, in] weight matrices are ~95% zeros (lanczos3
+    # support at these scales is 10-13 taps of 512): gather a STATIC
+    # K-tap band per output row instead and contract over K — ~30x
+    # fewer MACs than the dense matmuls, traded against gather cost and
+    # VPU (not MXU) reduction. K comes from THE shared serving-side
+    # computation (ops/resample.py band_taps/bucket_taps — the same
+    # figures select_band_taps keys programs by), so the experiment and
+    # the serving kernel can never disagree about what K a geometry
+    # needs. (The pre-promotion draft hard-coded K=16, valid only for
+    # scale <= 1.71 — an upscale or deeper downscale would have dropped
+    # contributing taps silently.)
+    ky = bucket_taps(band_taps("lanczos3", float(span_y[1]) / oh))
+    kx = bucket_taps(band_taps("lanczos3", float(span_x[1]) / ow))
+
     def banded_one(img):
-        # The dense [out, in] weight matrices are ~95% zeros (lanczos3
-        # support at these scales is 10-13 taps of 512): gather a STATIC
-        # K-tap band per output row instead and contract over K — ~30x
-        # fewer MACs than the dense matmuls, traded against gather cost
-        # and VPU (not MXU) reduction. K=16 covers radius 3*scale + 2 for
-        # every geometry this experiment runs (scale <= 1.71 -> 11 taps).
-        K = 16
-        h, w, c = img.shape
-
-        def band(in_size, out_size, start, size, otrue, itrue):
-            i = jnp.arange(out_size, dtype=jnp.float32)
-            x = start + (i + 0.5) * (size / jnp.maximum(otrue, 1.0)) - 0.5
-            x = jnp.clip(x, 0.0, jnp.maximum(itrue - 1.0, 0.0))
-            s = jnp.maximum(size / jnp.maximum(otrue, 1.0), 1.0)
-            j0 = jnp.floor(x).astype(jnp.int32) - K // 2 + 1
-            j = j0[:, None] + jnp.arange(K, dtype=jnp.int32)[None, :]
-            # weights from the UNCLIPPED tap positions, zeroed out of
-            # range — clipping first would pile duplicate taps on the
-            # edge samples and over-weight them (cols 0-2 were off by up
-            # to 94 uint8 levels before this)
-            from flyimg_tpu.ops.resample import _kernel_fn
-
-            d = (j.astype(jnp.float32) - x[:, None]) / s
-            wts = _kernel_fn("lanczos3", d)  # THE serving kernel, not a copy
-            wts = jnp.where(
-                (j >= 0) & (j.astype(jnp.float32) < itrue), wts, 0.0
-            )
-            denom = wts.sum(axis=-1, keepdims=True)
-            idx = jnp.clip(j, 0, in_size - 1)
-            return idx, wts / jnp.where(denom == 0.0, 1.0, denom)
-
-        iy, wy = band(h, oh, span_y[0], span_y[1], out_true[0], in_true[0])
-        ix, wx = band(w, ow, span_x[0], span_x[1], out_true[1], in_true[1])
-        rows = jnp.take(img, iy, axis=0)          # [oh, K, w, c]
-        tmp = jnp.einsum("ok,okwc->owc", wy, rows)
-        cols = jnp.take(tmp, ix, axis=1)          # [oh, ow, K, c]
-        return jnp.einsum("ok,hokc->hoc", wx, cols)
+        return resample_image_banded(
+            img, (oh, ow), span_y, span_x, out_true, in_true, (ky, kx),
+        )
 
     variants = {
         "base": base_one,

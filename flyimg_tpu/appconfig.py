@@ -52,6 +52,15 @@ SERVER_DEFAULTS: Dict[str, Any] = {
     # host-codec batch controller (native DecodePool JPEG-miss decode)
     "decode_batch_max": 32,
     "decode_deadline_ms": 1.0,
+    # serving resample kernel (ops/resample.py; docs/kernels.md):
+    # 'dense' = the shipped [out, in] weight-matrix einsums; 'banded' =
+    # static K-tap gather-contract (~30x fewer resample MACs at serving
+    # scales); 'auto' = banded whenever the band is narrower than the
+    # dense matrix. The FLYIMG_RESAMPLE_KERNEL env var seeds the default
+    # so offline A/B tools (bench.py, tools/chip_suite.py) flip the
+    # variant without config plumbing. Default dense until BENCH_r06
+    # confirms the on-chip win.
+    "resample_kernel": os.environ.get("FLYIMG_RESAMPLE_KERNEL", "dense"),
     # face engine selection + optional blazeface checkpoint dir
     # (models/faces.py make_face_backend)
     "face_backend": "auto",

@@ -71,8 +71,12 @@ def bulk_process(
     )
     params = AppParameters()
     own_batcher = batcher is None
+    from flyimg_tpu.ops.resample import set_kernel_mode
     from flyimg_tpu.runtime.batcher import containment_params
 
+    # same resample-kernel selection serving applies (service/app.py):
+    # an offline sweep must run the variant the config names
+    set_kernel_mode(str(params.by_key("resample_kernel", "dense")))
     containment = containment_params(params)
     if own_batcher:
         # same tunables serving reads (service/app.py): an operator's

@@ -30,7 +30,12 @@ import numpy as np
 from flyimg_tpu.ops.color import monochrome_dither, to_grayscale
 from flyimg_tpu.ops.filters import gaussian_blur, sharpen as sharpen_op, unsharp_mask
 from flyimg_tpu.ops.pad import extent_pad
-from flyimg_tpu.ops.resample import resample_image
+from flyimg_tpu.ops.resample import (
+    kernel_mode,
+    resample_image,
+    resample_image_banded,
+    select_band_taps,
+)
 from flyimg_tpu.ops.rotate import rotate_image, rotate_image_dynamic
 from flyimg_tpu.spec.geometry import gravity_offset
 from flyimg_tpu.spec.plan import TransformPlan
@@ -104,6 +109,7 @@ def make_program_fn(
     pad_offset: Tuple[int, int],
     plan: TransformPlan,
     rotate_dynamic: bool = False,
+    band_taps: Optional[Tuple[int, int]] = None,
 ):
     """The raw (unjitted) device program closure for one op config. Shared
     by the single-image path (build_program jits it) and the batch runtime
@@ -112,16 +118,29 @@ def make_program_fn(
     With ``rotate_dynamic`` the rotate stage runs on a shape-bucketed frame
     with traced valid dims, so mixed-size rotate traffic shares one
     executable; ``in_true`` is then [h, w, rot_h, rot_w] — valid input dims
-    plus the host-computed rotated output extent (see final_extent)."""
+    plus the host-computed rotated output extent (see final_extent).
+
+    ``band_taps`` selects the resample formulation: None runs the dense
+    [out, in] matrix einsums; ``(Ky, Kx)`` runs the banded K-tap
+    gather-contract (ops/resample.py resample_image_banded) with those
+    STATIC per-axis band widths — callers derive them from the plan's
+    true geometry via ``select_band_taps`` and carry them in the program
+    cache key (docs/kernels.md)."""
 
     def program(img_u8, in_true, span_y, span_x, out_true):
         x = img_u8.astype(jnp.float32)
         cur_true = in_true[:2]
         if resample_out is not None:
-            x = resample_image(
-                x, resample_out, span_y, span_x, out_true, in_true[:2],
-                method=plan.filter_method,
-            )
+            if band_taps is not None:
+                x = resample_image_banded(
+                    x, resample_out, span_y, span_x, out_true,
+                    in_true[:2], band_taps, method=plan.filter_method,
+                )
+            else:
+                x = resample_image(
+                    x, resample_out, span_y, span_x, out_true, in_true[:2],
+                    method=plan.filter_method,
+                )
             cur_true = out_true
         if pad_canvas is not None:
             x = extent_pad(x, pad_canvas, pad_offset, plan.background)
@@ -174,10 +193,14 @@ def _ledger():
 
 def plan_descriptor(plan: TransformPlan, *, in_shape=None, batch=None,
                     resample_out=None, pad_canvas=None,
-                    rotate_dynamic=False) -> Dict[str, object]:
+                    rotate_dynamic=False,
+                    band_taps=None) -> Dict[str, object]:
     """Compact human-readable program identity for the cost ledger /
     ``/debug/plans`` — which ops the program fuses and at what static
-    shapes, without dumping the whole TransformPlan repr."""
+    shapes, without dumping the whole TransformPlan repr. ``kernel``
+    names the resample formulation (dense | banded) so dense and banded
+    ledger entries are tellable apart at a glance; banded entries also
+    carry their static per-axis band widths."""
     ops = []
     if resample_out is not None:
         ops.append("resample")
@@ -202,6 +225,9 @@ def plan_descriptor(plan: TransformPlan, *, in_shape=None, batch=None,
         desc["batch"] = int(batch)
     if resample_out is not None:
         desc["resample_out"] = list(resample_out)
+        desc["kernel"] = "banded" if band_taps is not None else "dense"
+        if band_taps is not None:
+            desc["band_taps"] = list(band_taps)
     if pad_canvas is not None:
         desc["pad_canvas"] = list(pad_canvas)
     desc["filter"] = plan.filter_method
@@ -251,6 +277,16 @@ class ProgramHandle:
         the jitted fallback) — the batcher's EXACT compile-hit signal,
         replacing the old lru-miss-count inference."""
         return self._compiled is not None or self._fallback
+
+    def precompile(self, args) -> None:
+        """Compile (and ledger-record) for ``args``'s shapes WITHOUT
+        executing — ``args`` may be ``jax.ShapeDtypeStruct`` abstract
+        values. Lets cost A/B tooling and tests obtain the ledger entry
+        for a geometry (e.g. the canonical 4k plan) that would be
+        seconds-per-image to actually execute on a CPU host."""
+        with self._lock:
+            if self._compiled is None and not self._fallback:
+                self._compile(args)
 
     def __call__(self, *args):
         compiled = self._compiled
@@ -327,6 +363,7 @@ def build_program(
     pad_canvas: Optional[Tuple[int, int]],
     pad_offset: Tuple[int, int],
     plan: TransformPlan,
+    band_taps: Optional[Tuple[int, int]] = None,
 ) -> ProgramHandle:
     """Compile (lazily, on first call) the device program for one op
     config at one padded input shape, as a ``ProgramHandle`` feeding the
@@ -334,14 +371,22 @@ def build_program(
     cache key ignores per-image geometry (it arrives as traced spans).
     ``in_shape`` keys the cache — one handle per input shape keeps each
     handle single-shape, which is what lets it hold ONE compiled
-    executable."""
-    key = ("single", in_shape, resample_out, pad_canvas, pad_offset, plan)
+    executable. ``band_taps`` is part of the cache AND ledger key:
+    dense and banded variants of one plan are distinct programs that
+    must never collide in either table."""
+    key = (
+        "single", in_shape, resample_out, pad_canvas, pad_offset, plan,
+        band_taps,
+    )
     return ProgramHandle(
-        jax.jit(make_program_fn(resample_out, pad_canvas, pad_offset, plan)),
+        jax.jit(make_program_fn(
+            resample_out, pad_canvas, pad_offset, plan,
+            band_taps=band_taps,
+        )),
         key,
         plan_descriptor(
             plan, in_shape=in_shape, resample_out=resample_out,
-            pad_canvas=pad_canvas,
+            pad_canvas=pad_canvas, band_taps=band_taps,
         ),
     )
 
@@ -429,12 +474,20 @@ def run_plan(image: np.ndarray, plan: TransformPlan) -> np.ndarray:
     layout = plan_layout(plan)
 
     slice_out = None
+    band = None
     if _needs_resample(plan, layout):
         bh, bw = _bucket_dim(h), _bucket_dim(w)
         padded = np.zeros((bh, bw, image.shape[2]), dtype=np.uint8)
         padded[:h, :w] = image
         resample_out = layout.resample_out
         in_shape = (bh, bw)
+        # kernel-variant policy from the member's TRUE geometry (the
+        # serving-wide resample_kernel knob; docs/kernels.md) — K is
+        # static per compile, so it joins the cache key below
+        band = select_band_taps(
+            kernel_mode(), plan.filter_method, in_shape,
+            layout.span_y, layout.span_x, layout.out_true,
+        )
     elif plan.rotate is None:
         # pixel-op-only plans also ride shape buckets (otherwise every
         # distinct source resolution would force a fresh XLA compile).
@@ -458,6 +511,7 @@ def run_plan(image: np.ndarray, plan: TransformPlan) -> np.ndarray:
         layout.pad_canvas,
         layout.pad_offset,
         plan.device_plan(),
+        band,
     )
     t0 = time.perf_counter()
     out = fn(

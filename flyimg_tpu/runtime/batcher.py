@@ -64,6 +64,7 @@ from flyimg_tpu.ops.compose import (
     plan_descriptor,
     plan_layout,
 )
+from flyimg_tpu.ops.resample import kernel_mode, select_band_taps
 from flyimg_tpu.runtime import costledger, tracing
 from flyimg_tpu.runtime.resilience import (
     POISON,
@@ -123,6 +124,7 @@ def build_batched_program(
     plan: TransformPlan,
     mesh=None,
     rotate_dynamic: bool = False,
+    band_taps: Optional[Tuple[int, int]] = None,
 ) -> ProgramHandle:
     """vmap of the single-image program over a static batch axis; with a
     mesh, the batch axis is sharded over its 'data' axis (SPMD fan-out, no
@@ -130,10 +132,13 @@ def build_batched_program(
     as a ``ProgramHandle``: the first call AOT-compiles and records XLA
     cost analysis in the per-plan ledger; ``handle.is_compiled`` is the
     batcher's exact compile-hit signal. One cache entry = one (batch,
-    shape) program = one compiled executable."""
+    shape) program = one compiled executable. ``band_taps`` (the banded
+    resample's static per-axis K; docs/kernels.md) is part of the cache
+    key AND the ledger key — dense and banded variants of one plan must
+    never collide in either."""
     inner = make_program_fn(
         resample_out, pad_canvas, pad_offset, plan,
-        rotate_dynamic=rotate_dynamic,
+        rotate_dynamic=rotate_dynamic, band_taps=band_taps,
     )
     if mesh is None:
         jitted = jax.jit(jax.vmap(inner))
@@ -150,6 +155,7 @@ def build_batched_program(
         "batched", batch_size, in_shape, resample_out, pad_canvas,
         pad_offset, plan, rotate_dynamic,
         tuple(mesh.shape.items()) if mesh is not None else None,
+        band_taps,
     )
     return ProgramHandle(
         jitted,
@@ -157,7 +163,7 @@ def build_batched_program(
         plan_descriptor(
             plan, in_shape=in_shape, batch=batch_size,
             resample_out=resample_out, pad_canvas=pad_canvas,
-            rotate_dynamic=rotate_dynamic,
+            rotate_dynamic=rotate_dynamic, band_taps=band_taps,
         ),
     )
 
@@ -192,6 +198,9 @@ class _Group:
     # arbitrary-angle rotate on a shape bucket: per-member geometry rides
     # in as traced scalars (in_true widens to [h, w, rot_h, rot_w])
     rotate_dynamic: bool = False
+    # banded-resample static per-axis K (None = dense); part of the group
+    # key, so members group by K bucket like they group by input shape
+    band_taps: Optional[Tuple[int, int]] = None
     # aux groups (e.g. batched smart-crop scoring) run this instead of the
     # vmapped transform program: runner(payloads) -> results, one per member
     runner: Optional[callable] = None
@@ -395,10 +404,20 @@ class BatchController:
             # static rotate (conv post-ops) without resample: exact frame
             in_shape = (h, w)
             resample_out = None
+        # kernel-variant policy from the member's TRUE geometry (the
+        # serving-wide resample_kernel knob): members whose geometry
+        # needs a different K bucket land in different groups, exactly
+        # like members in different input-shape buckets (docs/kernels.md)
+        band_taps = None
+        if needs_resample:
+            band_taps = select_band_taps(
+                kernel_mode(), plan.filter_method, in_shape,
+                layout.span_y, layout.span_x, layout.out_true,
+            )
         device_plan = plan.device_plan()
         key = (
             in_shape, resample_out, layout.pad_canvas, layout.pad_offset,
-            device_plan, rotate_dynamic,
+            device_plan, rotate_dynamic, band_taps,
         )
         future: Future = Future()
         submit_span = tracing.current_span()
@@ -447,6 +466,7 @@ class BatchController:
                 pad_offset=layout.pad_offset,
                 device_plan=device_plan,
                 rotate_dynamic=rotate_dynamic,
+                band_taps=band_taps,
                 base_key=base_key,
             ),
         )
@@ -834,6 +854,7 @@ class BatchController:
             device_plan=group.device_plan,
             members=take,
             rotate_dynamic=group.rotate_dynamic,
+            band_taps=group.band_taps,
             runner=group.runner,
             base_key=group.base_key,
         )
@@ -1170,6 +1191,7 @@ class BatchController:
             group.device_plan,
             self.mesh,
             group.rotate_dynamic,
+            group.band_taps,
         )
         compile_hit = fn.is_compiled
         self.metrics.record_compile_event(compile_hit)

@@ -182,6 +182,13 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
     )
     debug_enabled = bool(params.by_key("debug"))
     log_access = bool(params.by_key("log_access", True))
+    # serving resample kernel (dense | banded | auto): process-wide like
+    # the program caches the choice keys into (ops/resample.py;
+    # docs/kernels.md). Applied BEFORE any program is built so the first
+    # compile already runs the configured variant.
+    from flyimg_tpu.ops.resample import set_kernel_mode
+
+    set_kernel_mode(str(params.by_key("resample_kernel", "dense")))
     storage = make_storage(params, metrics=metrics)
     import jax
 

@@ -824,7 +824,7 @@ class ImageHandler:
             ).inc(len(data))
 
         w, h = decoded.size
-        plan = build_plan(options, w, h)
+        plan = build_plan(options, w, h, metrics=self.metrics)
         quality_cap = None
         if degrade is not None:
             plan, dropped = degrade_plan(plan)
@@ -908,7 +908,9 @@ class ImageHandler:
                 if (fw, fh) == plan.src_size:
                     frame_plan = plan
                 else:
-                    frame_plan = build_plan(options, fw, fh)
+                    frame_plan = build_plan(
+                        options, fw, fh, metrics=self.metrics
+                    )
                     if degrade is not None:
                         # rebuilt per-frame plans (animation frames whose
                         # dims differ) must degrade identically to the

@@ -35,6 +35,10 @@ from flyimg_tpu.spec.geometry import (
 from flyimg_tpu.spec.options import OptionsBag
 
 # resize filter name -> resample method (IM filter names; jax.image methods).
+# THE supported f_ vocabulary (docs/application-options.md "Resize filter
+# vocabulary"); anything else aliases to lanczos3 — LOUDLY (resolve_filter
+# counts + span-events the alias; ROADMAP item 5 tracks honoring the full
+# IM vocabulary instead).
 FILTER_METHODS = {
     "lanczos": "lanczos3",
     "triangle": "triangle",
@@ -44,6 +48,49 @@ FILTER_METHODS = {
     "catrom": "cubic",
     "gaussian": "gaussian",  # true taps (ops/resample.py _kernel_fn)
 }
+
+
+# cardinality bound for the alias counter's client-controlled label:
+# the first N distinct unknown names get their own series (enough to
+# diagnose any real typo/vocabulary gap), everything past that counts
+# under one overflow label so a crawler spraying random f_ values can't
+# grow the registry/exposition without bound
+_ALIASED_FILTER_SERIES_MAX = 32
+_aliased_filter_names: set = set()
+
+
+def resolve_filter(options: "OptionsBag", metrics=None) -> str:
+    """The f_ option -> resample method, aliasing unknown names to
+    lanczos3 like the reference's IM default — but NOT silently: an
+    alias emits a ``flyimg_filter_aliased_total{filter=}`` counter (when
+    a registry is wired) and a ``filter.aliased`` span event on the
+    active request trace, so a typo'd or not-yet-supported filter name
+    is visible in /metrics and /debug/traces instead of quietly serving
+    Lanczos bytes under the wrong label."""
+    raw = str(options.get_option("filter") or "Lanczos").lower()
+    method = FILTER_METHODS.get(raw)
+    if method is not None:
+        return method
+    # lazy imports: spec is a lower layer than runtime (runtime.batcher
+    # imports this module), so module-scope imports here would cycle
+    from flyimg_tpu.runtime import tracing
+
+    tracing.add_event("filter.aliased", filter=raw, method="lanczos3")
+    if metrics is not None:
+        from flyimg_tpu.runtime.metrics import escape_label_value
+
+        label = raw[:48]
+        if label not in _aliased_filter_names:
+            if len(_aliased_filter_names) >= _ALIASED_FILTER_SERIES_MAX:
+                label = "_other"
+            else:
+                _aliased_filter_names.add(label)
+        metrics.counter(
+            "flyimg_filter_aliased_total"
+            f'{{filter="{escape_label_value(label)}"}}',
+            "Unknown f_ filter names aliased to the lanczos3 default",
+        ).inc()
+    return "lanczos3"
 
 def parse_colorspace(options: "OptionsBag") -> Optional[str]:
     """THE clsp_ parser (build_plan and the handler's container check
@@ -300,6 +347,7 @@ def build_plan(
     options: OptionsBag,
     src_w: int,
     src_h: int,
+    metrics=None,
 ) -> TransformPlan:
     """Resolve an OptionsBag + source dims into a TransformPlan.
 
@@ -358,8 +406,7 @@ def build_plan(
         extent=parse_extent(options.get_option("extent")),
     )
 
-    filter_name = str(options.get_option("filter") or "Lanczos").lower()
-    filter_method = FILTER_METHODS.get(filter_name, "lanczos3")
+    filter_method = resolve_filter(options, metrics=metrics)
     # rz_1 selects -resize over -thumbnail in the reference (ImageProcessor
     # .php:264-272); both are the same resample here (thumbnail only adds
     # metadata stripping, which is a host/encode concern).

@@ -80,3 +80,22 @@ def test_bench_http_report_all_failed_row_is_schema_complete(capsys):
     ok = mod._report("miss", "rated@10", [0.01, 0.02], 0, 1.0)
     assert ok["saturated"] is False
     assert ok["latency_ms"]["p99"] is not None
+
+
+def test_bench_http_rows_carry_kernel_tag_for_ab_legs():
+    """--kernel legs (chip_suite dense-vs-banded A/B) stamp the variant
+    into every row — success AND saturated — so sweep artifacts can tell
+    the two rated-miss curves apart; without --kernel the field is
+    absent (an untagged --base target's variant is unknown)."""
+    mod = _load_bench_http()
+    assert "kernel" not in mod._report("miss", "rated@10", [0.01], 0, 1.0)
+    mod._KERNEL_TAG = "banded"
+    try:
+        assert mod._report(
+            "miss", "rated@10", [0.01], 0, 1.0
+        )["kernel"] == "banded"
+        assert mod._report(
+            "miss", "rated@500", [], 9, 1.0
+        )["kernel"] == "banded"
+    finally:
+        mod._KERNEL_TAG = None

@@ -3,7 +3,10 @@ attribution on failure, and the end-to-end self-test — an unmodified
 tree passes, a fault-injected device slowdown fails with the device
 stage named (ISSUE 4 acceptance) — plus the schema-2 per-plan cost
 gate: deterministic FLOP/byte figures compared WITHOUT host scaling,
-failing on an injected FLOP regression (ISSUE 7 acceptance)."""
+failing on an injected FLOP regression (ISSUE 7 acceptance) — plus the
+schema-3 per-kernel columns: dense and banded legs gated independently,
+pre-schema-3 baselines read as the dense column, and a kernel the
+baseline never measured surfaces as missing, never failing (ISSUE 8)."""
 
 import json
 import os
@@ -181,9 +184,15 @@ def test_gate_cost_self_test_injected_flop_regression_fails(tmp_path):
     update = run("--update")
     assert update.returncode == 0, update.stderr
     doc = json.loads(baseline.read_text())
-    assert doc["schema"] == 2
-    assert doc["plan_cost"]["flops_total"] and \
-        doc["plan_cost"]["flops_total"] > 0
+    assert doc["schema"] == 3
+    assert set(doc["kernels"]) == {"dense", "banded"}
+    for kern in ("dense", "banded"):
+        cost = doc["kernels"][kern]["plan_cost"]
+        assert cost["flops_total"] and cost["flops_total"] > 0
+    # the point of the banded kernel: materially fewer resample FLOPs
+    # for the same plans (device stage dominates the FLOP total)
+    assert doc["kernels"]["banded"]["plan_cost"]["flops_total"] < \
+        doc["kernels"]["dense"]["plan_cost"]["flops_total"]
     check = run("--check", "--tolerance", "8.0")
     assert check.returncode == 0, check.stdout + check.stderr
     injected = run(
@@ -199,16 +208,16 @@ def test_gate_end_to_end_pass_then_injected_fail(tmp_path):
     """The acceptance self-test: measure -> self-baseline -> --check
     passes; with the device-stage latency spike armed, --check fails and
     the report names the device stage."""
-    current = perf_gate.measure(repeats=4, warmup=2)
+    current = perf_gate.measure_suite(("dense",), repeats=4, warmup=2)
     baseline_path = tmp_path / "baseline.json"
     baseline_path.write_text(json.dumps(current))
     rc = perf_gate.main([
-        "--check", "--baseline", str(baseline_path),
+        "--check", "--baseline", str(baseline_path), "--kernel", "dense",
         "--repeats", "4", "--warmup", "1", "--tolerance", "6.0",
     ])
     assert rc == 0
     rc = perf_gate.main([
-        "--check", "--baseline", str(baseline_path),
+        "--check", "--baseline", str(baseline_path), "--kernel", "dense",
         "--repeats", "4", "--warmup", "1", "--tolerance", "6.0",
         "--inject", "device=0.2",
     ])
@@ -222,10 +231,63 @@ def test_measure_produces_all_stages_quick():
         doc["stages"][s]["median_ms"] >= 0 for s in perf_gate.STAGES
     )
     assert doc["calibration_ms"] > 0
-    # schema 2 carries the per-plan cost snapshot; in a shared test
+    # the leg carries the per-plan cost snapshot; in a shared test
     # process the suite's programs may already be ledgered (the diff is
     # empty -> nulled totals, the documented non-failing case)
-    assert doc["schema"] == 2
     assert "plan_cost" in doc
     flops = doc["plan_cost"]["flops_total"]
     assert flops is None or flops > 0
+    # and measure() restores the process-wide kernel mode it pinned
+    from flyimg_tpu.ops.resample import kernel_mode
+
+    before = kernel_mode()
+    perf_gate.measure(repeats=1, warmup=1, kernel="banded")
+    assert kernel_mode() == before
+
+
+def test_compare_gates_kernels_independently():
+    """Schema 3: a banded-leg regression fails even when dense is clean,
+    and vice versa — the column exists so one variant can't hide behind
+    the other."""
+    def suite(dense, banded):
+        return {
+            "schema": 3, "calibration_ms": 5.0,
+            "kernels": {
+                "dense": {"stages": {k: {"median_ms": v}
+                                     for k, v in dense.items()}},
+                "banded": {"stages": {k: {"median_ms": v}
+                                      for k, v in banded.items()}},
+            },
+        }
+
+    ok, report = perf_gate.compare(
+        suite(BASE, BASE), suite(BASE, dict(BASE, device=120.0)),
+        tolerance=1.5,
+    )
+    assert not ok
+    bad = [r for r in report["rows"] if r["verdict"] == "REGRESSED"]
+    assert [(r["kernel"], r["stage"]) for r in bad] == [("banded", "device")]
+
+
+def test_compare_pre_schema3_baseline_reads_as_dense_column():
+    """A schema-1/2 baseline gates the dense leg; the banded leg it
+    never measured surfaces as missing without failing."""
+    current = {
+        "schema": 3, "calibration_ms": 5.0,
+        "kernels": {
+            "dense": {"stages": {k: {"median_ms": v}
+                                 for k, v in BASE.items()}},
+            "banded": {"stages": {k: {"median_ms": v}
+                                  for k, v in BASE.items()}},
+        },
+    }
+    ok, report = perf_gate.compare(_doc(BASE), current, tolerance=1.5)
+    assert ok
+    verdicts = {(r["kernel"], r["stage"]): r["verdict"]
+                for r in report["rows"]}
+    assert verdicts[("dense", "device")] == "ok"
+    assert verdicts[("banded", "device")] == "missing"
+    # dense regression against the old baseline still fails
+    current["kernels"]["dense"]["stages"]["device"]["median_ms"] = 120.0
+    ok, _ = perf_gate.compare(_doc(BASE), current, tolerance=1.5)
+    assert not ok

@@ -151,6 +151,12 @@ async def _miss_run(
     return latencies, failures, elapsed
 
 
+# resample-kernel variant tag for the A/B legs (--kernel): stamped into
+# every result row so sweep artifacts can tell dense and banded curves
+# apart; None (no --kernel) omits the field
+_KERNEL_TAG = None
+
+
 def _report(name: str, mode: str, lat, failures: int, elapsed: float):
     if not lat:
         # all-failed legs are the MOST important rows of an overload
@@ -171,6 +177,8 @@ def _report(name: str, mode: str, lat, failures: int, elapsed: float):
                 "max": None,
             },
         }
+        if _KERNEL_TAG is not None:
+            row["kernel"] = _KERNEL_TAG
         print(f"{name:8s} {mode:6s}  ALL {failures} REQUESTS FAILED "
               "(saturated)")
         print(json.dumps(row))
@@ -191,6 +199,8 @@ def _report(name: str, mode: str, lat, failures: int, elapsed: float):
             "max": round(float(arr.max()), 2),
         },
     }
+    if _KERNEL_TAG is not None:
+        row["kernel"] = _KERNEL_TAG
     print(
         f"{name:8s} {mode:6s}  {row['throughput_rps']:8.1f} req/s   "
         f"mean {row['latency_ms']['mean']:7.2f}  p50 {row['latency_ms']['p50']:7.2f}  "
@@ -293,11 +303,20 @@ async def main() -> int:
              "'misses' into 4 ms cache hits (found the hard way, round 5)")
     ap.add_argument("--spawn", action="store_true", help="start the service here")
     ap.add_argument("--source", default="var/tmp/bench-source.jpg")
+    ap.add_argument(
+        "--kernel", default=None, choices=("dense", "banded", "auto"),
+        help="resample-kernel variant for the A/B legs (docs/kernels.md): "
+             "written into the spawned service's params and stamped into "
+             "every result row. With --base it only stamps the rows — the "
+             "target's own config decides what actually runs")
     args = ap.parse_args()
 
     if args.base and args.spawn:
         print("--base and --spawn are mutually exclusive", file=sys.stderr)
         return 2
+
+    global _KERNEL_TAG
+    _KERNEL_TAG = args.kernel
 
     proc = None
     store = None
@@ -322,6 +341,8 @@ async def main() -> int:
         params_path = os.path.join(params_dir, "params.yml")
         with open(params_path, "w") as fh:
             fh.write("debug: true\n")
+            if args.kernel is not None:
+                fh.write(f"resample_kernel: {args.kernel}\n")
             if store is not None:
                 fh.write(f"upload_dir: {os.path.join(store, 'out')}\n")
         spawn_cmd += ["--params", params_path]
@@ -501,6 +522,7 @@ async def main() -> int:
                         "backend": os.environ.get(
                             "JAX_PLATFORMS", "default"
                         ),
+                        "kernel": args.kernel,
                         "rows": sweep,
                     }, fh, indent=1)
                     fh.write("\n")

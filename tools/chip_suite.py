@@ -86,6 +86,12 @@ def main() -> int:
     ap.add_argument("--skip", action="append", default=[],
                     choices=["resample", "bench", "ops", "bulk", "http"])
     ap.add_argument("--bulk-src", default="var/bench_images")
+    ap.add_argument(
+        "--kernels", default="dense,banded",
+        help="comma list of resample-kernel variants (docs/kernels.md) "
+             "to A/B through the bench and http stages — the default "
+             "arms the next hardware window to capture the headline AND "
+             "the rated-miss curve for both variants")
     args = ap.parse_args()
     if args.out is None:
         args.out = f"benchmarks/chip_suite_{args.round}.json"
@@ -94,6 +100,19 @@ def main() -> int:
     # suite behaves identically from any invoking directory
     args.out = os.path.join(REPO, args.out)
     args.bulk_src = os.path.join(REPO, args.bulk_src)
+
+    kernels = [k.strip() for k in args.kernels.split(",") if k.strip()]
+    # validate HERE, loudly and BEFORE the compute probe burns its
+    # window: the env seed in ops/resample.py silently sanitizes unknown
+    # values to dense, so a typo'd --kernels entry would otherwise
+    # record two dense legs under A/B stage names (vocabulary =
+    # resample.KERNEL_MODES; literal to keep the orchestrator from
+    # importing jax)
+    unknown = [k for k in kernels if k not in ("dense", "banded", "auto")]
+    if unknown:
+        print(f"unknown --kernels value(s) {unknown}; "
+              "expected dense|banded|auto", file=sys.stderr)
+        return 2
 
     results = []
 
@@ -149,11 +168,18 @@ def main() -> int:
         # Deadline 900s: a COLD compile of the two scan programs through
         # the tunnel measured ~200s each under host load — the original
         # 600s cap killed a healthy child mid-compile (2026-07-31); the
-        # persistent compile cache makes warm runs finish in ~2 min
-        run_stage("bench_headline", [py, "bench.py"], 2000, results,
-                  env={"FLYIMG_BENCH_SKIP_PROBE": "1",
-                       "FLYIMG_BENCH_DEADLINE": "900"})
-        flush()
+        # persistent compile cache makes warm runs finish in ~2 min.
+        # One leg per resample-kernel variant (dense-vs-banded A/B):
+        # FLYIMG_RESAMPLE_KERNEL seeds the flagship's formulation and
+        # bench.py stamps the variant into its final JSON line, so
+        # bench_history.jsonl records which kernel set each headline
+        for kern in kernels:
+            run_stage(f"bench_headline_{kern}", [py, "bench.py"], 2000,
+                      results,
+                      env={"FLYIMG_BENCH_SKIP_PROBE": "1",
+                           "FLYIMG_BENCH_DEADLINE": "900",
+                           "FLYIMG_RESAMPLE_KERNEL": kern})
+            flush()
     if "ops" not in args.skip:
         run_stage(
             "device_ops",
@@ -180,13 +206,19 @@ def main() -> int:
             })
         flush()
     if "http" not in args.skip:
-        run_stage(
-            "http_latency",
-            [py, "tools/bench_http.py", "--spawn", "--burst", "3000",
-             "--conc", "64", "--miss", "256"],
-            1800, results,
-        )
-        flush()
+        # same A/B through the full HTTP serving path: each leg spawns
+        # its own service with resample_kernel pinned, so the per-row
+        # attribution (plan_costs) and the miss latencies are variant-
+        # tagged end to end
+        for kern in kernels:
+            run_stage(
+                f"http_latency_{kern}",
+                [py, "tools/bench_http.py", "--spawn", "--burst", "3000",
+                 "--conc", "64", "--miss", "256", "--kernel", kern,
+                 "--fresh-storage"],
+                1800, results,
+            )
+            flush()
     flush()
     print(json.dumps({"stages": [
         {k: e.get(k) for k in ("stage", "rc", "seconds")} for e in results

@@ -35,6 +35,16 @@ banded-resample promotion (ROADMAP item 1) is judged by.
 ``--inject-cost flops=3.0`` is the matching self-test: it scales the
 measured FLOPs and must fail the gate.
 
+**Schema 3** adds a per-kernel column: the suite runs once per resample
+kernel variant (``dense`` and ``banded``, ops/resample.py kernel modes;
+docs/kernels.md) and the baseline keys each measurement under
+``kernels.<variant>`` — so a change to one variant can never silently
+regress the *other* (the dense-only schema-2 gate would have waved a
+banded regression through, and vice versa once banded is the default).
+``--kernel dense|banded|both`` selects the legs; a baseline missing the
+requested kernel section reports it as ``missing`` without failing, so
+schema-1/2 baselines stay checkable until refreshed.
+
 CI: the ``perf-gate`` job runs ``--check`` with wide, CI-noise-tolerant
 bands (see .github/workflows/ci.yml). Baseline refresh policy:
 benchmarks/README.md.
@@ -64,7 +74,11 @@ COST_FIELDS = ("flops_total", "bytes_total")
 # stages on shared runners jitter by fractions of a ms that no relative
 # band should be asked to absorb
 ABS_SLACK_MS = 2.0
-SCHEMA = 2
+SCHEMA = 3
+# the resample-kernel variants each baseline carries a column for
+# (ops/resample.py KERNEL_MODES minus 'auto', which resolves to one of
+# these per geometry and would gate nothing new)
+KERNELS = ("dense", "banded")
 
 
 def _calibrate(rounds: int = 5) -> float:
@@ -111,13 +125,23 @@ def _parse_inject_cost(spec: str) -> float:
 
 def measure(repeats: int = 30, warmup: int = 3,
             inject: str | None = None,
-            inject_cost: str | None = None) -> dict:
-    """Run the micro-suite; returns {stages: {name: {median_ms}},
-    plan_cost: {...}, calibration_ms, repeats}. Import-heavy work happens
-    here so --help stays instant."""
+            inject_cost: str | None = None,
+            kernel: str | None = None) -> dict:
+    """Run the micro-suite for ONE resample-kernel leg; returns
+    {kernel, stages: {name: {median_ms}}, plan_cost: {...},
+    calibration_ms, repeats}. ``kernel`` (dense|banded) pins the
+    process-wide resample formulation for the leg and restores the prior
+    mode after — the program caches key on the variant, so both legs'
+    programs coexist and each leg's cost snapshot diffs only its own
+    newly-compiled programs. Import-heavy work happens here so --help
+    stays instant."""
     from flyimg_tpu.parallel.mesh import ensure_env_platform
 
     ensure_env_platform()
+
+    from flyimg_tpu.ops.resample import kernel_mode, set_kernel_mode
+
+    prev_kernel = kernel_mode()
 
     import numpy as np
 
@@ -161,6 +185,12 @@ def measure(repeats: int = 30, warmup: int = 3,
 
     rows: dict = {stage: [] for stage in STAGES}
     try:
+        # pin the process-wide kernel mode INSIDE the try so any failure
+        # (in-process callers: the pytest suite) restores prev_kernel —
+        # the mode only matters at submit time, so pinning here still
+        # covers every program build below
+        if kernel is not None:
+            set_kernel_mode(kernel)
         def run_miss(tag: str) -> dict:
             timings: dict = {}
             options = OptionsBag(options_str)
@@ -195,6 +225,7 @@ def measure(repeats: int = 30, warmup: int = 3,
         if injector is not None:
             faults.clear()
         batcher.close()
+        set_kernel_mode(prev_kernel)
 
     # the suite's per-plan cost snapshot (XLA cost analysis from the
     # ledger entries the run created): deterministic per jax version —
@@ -226,7 +257,7 @@ def measure(repeats: int = 30, warmup: int = 3,
     }
 
     return {
-        "schema": SCHEMA,
+        "kernel": kernel if kernel is not None else prev_kernel,
         "repeats": repeats,
         "calibration_ms": round(_calibrate() * 1000.0, 4),
         "stages": {
@@ -241,6 +272,40 @@ def measure(repeats: int = 30, warmup: int = 3,
     }
 
 
+def measure_suite(kernels=KERNELS, repeats: int = 30, warmup: int = 3,
+                  inject: str | None = None,
+                  inject_cost: str | None = None) -> dict:
+    """Run one measure() leg per resample-kernel variant and assemble
+    the schema-3 document: ``kernels.<variant> = {stages, plan_cost}``
+    with one shared host-calibration yardstick."""
+    legs = {k: measure(repeats=repeats, warmup=warmup, inject=inject,
+                       inject_cost=inject_cost, kernel=k)
+            for k in kernels}
+    first = next(iter(legs.values()))
+    return {
+        "schema": SCHEMA,
+        "repeats": repeats,
+        "calibration_ms": first["calibration_ms"],
+        "kernels": {
+            k: {"stages": leg["stages"], "plan_cost": leg["plan_cost"]}
+            for k, leg in legs.items()
+        },
+    }
+
+
+def kernel_sections(doc: dict) -> dict:
+    """{variant: {stages, plan_cost}} from any baseline schema: schema-3
+    docs carry ``kernels`` natively; schema-1/2 docs (and raw measure()
+    legs) ARE the dense column — their top-level stages/plan_cost were
+    measured with the then-only dense kernel."""
+    if "kernels" in doc:
+        return dict(doc["kernels"])
+    return {"dense": {
+        "stages": doc.get("stages", {}),
+        "plan_cost": doc.get("plan_cost"),
+    }}
+
+
 def compare(baseline: dict, current: dict, tolerance: float,
             abs_slack_ms: float = ABS_SLACK_MS,
             cost_tolerance: float = 1.2):
@@ -251,57 +316,74 @@ def compare(baseline: dict, current: dict, tolerance: float,
     ``current > baseline * cost_tolerance`` — NO host scaling: FLOPs and
     bytes are properties of the compiled programs, not the host. A
     schema-1 baseline (or an uncosted backend) reports the cost rows as
-    ``missing`` without failing, so old baselines stay checkable."""
+    ``missing`` without failing, so old baselines stay checkable.
+
+    Schema 3: both docs resolve to per-kernel sections via
+    ``kernel_sections`` and every current (kernel, stage) pair is gated
+    against the baseline's same-kernel column. A kernel the baseline
+    never measured (e.g. ``banded`` against a schema-2 baseline) reports
+    every row as ``missing`` without failing — refresh policy in
+    benchmarks/README.md. Report rows carry a ``kernel`` field."""
     cal_base = float(baseline.get("calibration_ms") or 0.0)
     cal_now = float(current.get("calibration_ms") or 0.0)
     scale = (cal_now / cal_base) if cal_base > 0 and cal_now > 0 else 1.0
+    base_sections = kernel_sections(baseline)
+    cur_sections = kernel_sections(current)
     rows = []
-    ok = True
-    for stage in STAGES:
-        base = baseline["stages"].get(stage, {}).get("median_ms")
-        cur = current["stages"].get(stage, {}).get("median_ms")
-        if base is None or cur is None:
-            rows.append({
-                "stage": stage, "verdict": "missing",
-                "baseline_ms": base, "current_ms": cur,
-            })
-            continue
-        allowed = base * scale * tolerance + abs_slack_ms
-        ratio = cur / (base * scale) if base * scale > 0 else float("inf")
-        regressed = cur > allowed
-        ok = ok and not regressed
-        rows.append({
-            "stage": stage,
-            "baseline_ms": base,
-            "scaled_baseline_ms": round(base * scale, 4),
-            "current_ms": cur,
-            "ratio": round(ratio, 3),
-            "allowed_ms": round(allowed, 4),
-            "verdict": "REGRESSED" if regressed else "ok",
-        })
     cost_rows = []
-    base_cost = baseline.get("plan_cost") or {}
-    cur_cost = current.get("plan_cost") or {}
-    for field in COST_FIELDS:
-        base = base_cost.get(field)
-        cur = cur_cost.get(field)
-        if base is None or cur is None or base <= 0:
-            cost_rows.append({
-                "field": field, "verdict": "missing",
-                "baseline": base, "current": cur,
+    ok = True
+    for kernel, cur_sec in cur_sections.items():
+        base_sec = base_sections.get(kernel) or {}
+        base_stages = base_sec.get("stages") or {}
+        cur_stages = cur_sec.get("stages") or {}
+        for stage in STAGES:
+            base = base_stages.get(stage, {}).get("median_ms")
+            cur = cur_stages.get(stage, {}).get("median_ms")
+            if base is None or cur is None:
+                rows.append({
+                    "kernel": kernel, "stage": stage, "verdict": "missing",
+                    "baseline_ms": base, "current_ms": cur,
+                })
+                continue
+            allowed = base * scale * tolerance + abs_slack_ms
+            ratio = (
+                cur / (base * scale) if base * scale > 0 else float("inf")
+            )
+            regressed = cur > allowed
+            ok = ok and not regressed
+            rows.append({
+                "kernel": kernel,
+                "stage": stage,
+                "baseline_ms": base,
+                "scaled_baseline_ms": round(base * scale, 4),
+                "current_ms": cur,
+                "ratio": round(ratio, 3),
+                "allowed_ms": round(allowed, 4),
+                "verdict": "REGRESSED" if regressed else "ok",
             })
-            continue
-        ratio = cur / base
-        regressed = cur > base * cost_tolerance
-        ok = ok and not regressed
-        cost_rows.append({
-            "field": field,
-            "baseline": base,
-            "current": cur,
-            "ratio": round(ratio, 3),
-            "allowed": round(base * cost_tolerance, 2),
-            "verdict": "REGRESSED" if regressed else "ok",
-        })
+        base_cost = base_sec.get("plan_cost") or {}
+        cur_cost = cur_sec.get("plan_cost") or {}
+        for field in COST_FIELDS:
+            base = base_cost.get(field)
+            cur = cur_cost.get(field)
+            if base is None or cur is None or base <= 0:
+                cost_rows.append({
+                    "kernel": kernel, "field": field, "verdict": "missing",
+                    "baseline": base, "current": cur,
+                })
+                continue
+            ratio = cur / base
+            regressed = cur > base * cost_tolerance
+            ok = ok and not regressed
+            cost_rows.append({
+                "kernel": kernel,
+                "field": field,
+                "baseline": base,
+                "current": cur,
+                "ratio": round(ratio, 3),
+                "allowed": round(base * cost_tolerance, 2),
+                "verdict": "REGRESSED" if regressed else "ok",
+            })
     return ok, {"scale": round(scale, 4), "tolerance": tolerance,
                 "cost_tolerance": cost_tolerance, "rows": rows,
                 "cost_rows": cost_rows}
@@ -313,27 +395,29 @@ def _print_report(report: dict, ok: bool) -> None:
         f"tolerance {report['tolerance']}x"
     )
     print(
-        f"{'stage':<10} {'baseline':>10} {'scaled':>10} {'current':>10} "
-        f"{'ratio':>7} {'allowed':>10}  verdict"
+        f"{'kernel':<7} {'stage':<10} {'baseline':>10} {'scaled':>10} "
+        f"{'current':>10} {'ratio':>7} {'allowed':>10}  verdict"
     )
     for row in report["rows"]:
+        kern = row.get("kernel", "dense")
         if row["verdict"] == "missing":
-            print(f"{row['stage']:<10} {'-':>10} {'-':>10} "
+            print(f"{kern:<7} {row['stage']:<10} {'-':>10} {'-':>10} "
                   f"{row['current_ms'] or '-':>10}  missing from baseline")
             continue
         print(
-            f"{row['stage']:<10} {row['baseline_ms']:>9.2f}m "
+            f"{kern:<7} {row['stage']:<10} {row['baseline_ms']:>9.2f}m "
             f"{row['scaled_baseline_ms']:>9.2f}m {row['current_ms']:>9.2f}m "
             f"{row['ratio']:>6.2f}x {row['allowed_ms']:>9.2f}m  "
             f"{row['verdict']}"
         )
     for row in report.get("cost_rows", []):
+        kern = row.get("kernel", "dense")
         if row["verdict"] == "missing":
-            print(f"cost {row['field']:<12} missing "
-                  "(schema-1 baseline or uncosted backend)")
+            print(f"{kern:<7} cost {row['field']:<12} missing "
+                  "(pre-schema-3 baseline or uncosted backend)")
             continue
         print(
-            f"cost {row['field']:<12} {row['baseline']:.3e} -> "
+            f"{kern:<7} cost {row['field']:<12} {row['baseline']:.3e} -> "
             f"{row['current']:.3e} ({row['ratio']}x, allowed "
             f"{row['allowed']:.3e})  {row['verdict']}"
         )
@@ -347,6 +431,7 @@ def _print_report(report: dict, ok: bool) -> None:
             if r.get("verdict") == "REGRESSED"
         ]
         attribution = ", ".join(
+            f"{r.get('kernel', 'dense')}/"
             f"{r.get('stage') or r.get('field')} {r['ratio']}x over "
             "baseline"
             for r in slowest
@@ -399,13 +484,20 @@ def main(argv=None) -> int:
              "scaling — cost analysis is deterministic per jax version)",
     )
     ap.add_argument(
+        "--kernel", choices=(*KERNELS, "both"), default="both",
+        help="which resample-kernel legs to run (schema-3 per-kernel "
+             "columns; 'both' measures dense AND banded so neither "
+             "variant can silently regress)",
+    )
+    ap.add_argument(
         "--json", action="store_true",
         help="also print the full current measurement as one JSON line",
     )
     ns = ap.parse_args(argv)
 
-    current = measure(
-        repeats=ns.repeats, warmup=ns.warmup, inject=ns.inject,
+    kernels = KERNELS if ns.kernel == "both" else (ns.kernel,)
+    current = measure_suite(
+        kernels, repeats=ns.repeats, warmup=ns.warmup, inject=ns.inject,
         inject_cost=ns.inject_cost,
     )
     if ns.json:
@@ -417,8 +509,9 @@ def main(argv=None) -> int:
             json.dump(current, fh, indent=1)
             fh.write("\n")
         print(f"wrote {ns.baseline}")
-        for stage, doc in current["stages"].items():
-            print(f"  {stage:<10} {doc['median_ms']:9.2f} ms")
+        for kern, sec in kernel_sections(current).items():
+            for stage, doc in sec["stages"].items():
+                print(f"  {kern:<7} {stage:<10} {doc['median_ms']:9.2f} ms")
         return 0
 
     if not os.path.exists(ns.baseline):
