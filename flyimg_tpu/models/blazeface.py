@@ -110,7 +110,7 @@ def anchor_centers() -> np.ndarray:
     return np.asarray(anchors, dtype=np.float32)
 
 
-_ANCHORS_NP = None
+_ANCHORS_NP: Optional[np.ndarray] = None
 
 
 def get_anchors() -> jnp.ndarray:

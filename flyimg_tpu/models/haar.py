@@ -20,11 +20,22 @@ import os
 import xml.etree.ElementTree as ET
 from dataclasses import dataclass
 from functools import lru_cache
-from typing import List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple, TypeVar
 
 import numpy as np
 
 Box = Tuple[int, int, int, int]
+
+_T = TypeVar("_T")
+
+
+def _req(value: Optional[_T], what: str) -> _T:
+    """Narrow an Optional from the ElementTree API: cascade XML files are
+    trusted repo/package data, so a missing node is a malformed-file
+    error, not a code path."""
+    if value is None:
+        raise ValueError(f"malformed cascade XML: missing {what}")
+    return value
 
 CASCADE_DIRS = (
     "/usr/share/opencv4/haarcascades",
@@ -52,12 +63,13 @@ class Stage:
     leaf_right: np.ndarray   # [n_stumps] float32
     # stage-vectorized feature geometry: [n_stumps, 3] rect params (one
     # whole stage evaluates as ~a dozen fancy-indexed gathers over every
-    # surviving window at once)
-    rx: np.ndarray = None
-    ry: np.ndarray = None
-    rw: np.ndarray = None
-    rh: np.ndarray = None
-    wgt: np.ndarray = None
+    # surviving window at once). None only on the first-parse pass in
+    # load_cascade; every stage the detector sees carries arrays.
+    rx: Optional[np.ndarray] = None
+    ry: Optional[np.ndarray] = None
+    rw: Optional[np.ndarray] = None
+    rh: Optional[np.ndarray] = None
+    wgt: Optional[np.ndarray] = None
 
 
 @dataclass(frozen=True)
@@ -75,16 +87,18 @@ def load_cascade(path: str) -> Cascade:
     casc = root.find("cascade")
     if casc is None or casc.findtext("featureType", "").strip() != "HAAR":
         raise ValueError(f"{path}: not a HAAR stump cascade")
-    win_w = int(casc.findtext("width"))
-    win_h = int(casc.findtext("height"))
+    win_w = int(_req(casc.findtext("width"), "width"))
+    win_h = int(_req(casc.findtext("height"), "height"))
 
     stages: List[Stage] = []
-    for st in casc.find("stages"):
-        thr = float(st.findtext("stageThreshold"))
+    for st in _req(casc.find("stages"), "stages"):
+        thr = float(_req(st.findtext("stageThreshold"), "stageThreshold"))
         fidx, nthr, ll, lr = [], [], [], []
-        for weak in st.find("weakClassifiers"):
-            nodes = weak.findtext("internalNodes").split()
-            leaves = weak.findtext("leafValues").split()
+        for weak in _req(st.find("weakClassifiers"), "weakClassifiers"):
+            nodes = _req(
+                weak.findtext("internalNodes"), "internalNodes"
+            ).split()
+            leaves = _req(weak.findtext("leafValues"), "leafValues").split()
             if len(nodes) != 4:
                 raise ValueError(f"{path}: tree cascades unsupported (stumps only)")
             fidx.append(int(nodes[2]))
@@ -101,13 +115,13 @@ def load_cascade(path: str) -> Cascade:
             )
         )
 
-    feats = casc.find("features")
+    feats = _req(casc.find("features"), "features")
     rects = np.zeros((len(feats), 3, 5), np.float32)
     for i, feat in enumerate(feats):
         if feat.find("tilted") is not None and feat.findtext("tilted", "0").strip() == "1":
             raise ValueError(f"{path}: tilted features unsupported")
-        for j, rect in enumerate(feat.find("rects")):
-            vals = rect.text.split()
+        for j, rect in enumerate(_req(feat.find("rects"), "rects")):
+            vals = _req(rect.text, "rect text").split()
             rects[i, j] = [float(v.rstrip(".")) for v in vals]
 
     staged = []
@@ -171,17 +185,24 @@ def _detect_single_scale(
     for stage in casc.stages:
         if alive.size == 0:
             break
+        s_rx, s_ry, s_rw, s_rh, s_wgt = (
+            stage.rx, stage.ry, stage.rw, stage.rh, stage.wgt,
+        )
+        assert (
+            s_rx is not None and s_ry is not None and s_rw is not None
+            and s_rh is not None and s_wgt is not None
+        ), "stage missing vectorized geometry (built by load_cascade)"
         ay = ys[alive][:, None]  # [n, 1] vs per-rect [K] grids -> [n, K]
         ax = xs[alive][:, None]
         fval = np.zeros((alive.size, stage.node_thresh.size), np.float64)
         for r in range(3):
-            wgt = stage.wgt[:, r]
+            wgt = s_wgt[:, r]
             if not wgt.any():
                 continue
-            y0 = ay + stage.ry[None, :, r]
-            x0 = ax + stage.rx[None, :, r]
-            y1 = y0 + stage.rh[None, :, r]
-            x1 = x0 + stage.rw[None, :, r]
+            y0 = ay + s_ry[None, :, r]
+            x0 = ax + s_rx[None, :, r]
+            y1 = y0 + s_rh[None, :, r]
+            x1 = x0 + s_rw[None, :, r]
             fval += wgt[None, :] * (
                 ii[y0, x0] + ii[y1, x1] - ii[y0, x1] - ii[y1, x0]
             )
@@ -239,7 +260,10 @@ def group_rectangles(
         if len(members) < min_neighbors:
             continue
         avg = arr[members].mean(axis=0)
-        out.append(tuple(int(round(v)) for v in avg))
+        out.append((
+            int(round(avg[0])), int(round(avg[1])),
+            int(round(avg[2])), int(round(avg[3])),
+        ))
     return out
 
 
@@ -301,7 +325,11 @@ def detect_faces_gray(
     boxes = group_rectangles(candidates, min_neighbors=min_neighbors)
     if prescale != 1.0:
         boxes = [
-            tuple(int(round(v * prescale)) for v in box) for box in boxes
+            (
+                int(round(x * prescale)), int(round(y * prescale)),
+                int(round(bw * prescale)), int(round(bh * prescale)),
+            )
+            for x, y, bw, bh in boxes
         ]
     boxes.sort(key=lambda b: (b[1], b[0]))
     return boxes

@@ -21,7 +21,7 @@ import threading
 import time
 from dataclasses import dataclass
 from functools import lru_cache
-from typing import Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -179,7 +179,7 @@ def make_program_fn(
 # cached module ref for the per-plan cost ledger (lazy: importing
 # flyimg_tpu.runtime at module scope would cycle through the batcher,
 # which imports this module)
-_costledger_mod = None
+_costledger_mod: Any = None
 
 
 def _ledger():
@@ -193,14 +193,19 @@ def _ledger():
 
 def plan_descriptor(plan: TransformPlan, *, in_shape=None, batch=None,
                     resample_out=None, pad_canvas=None,
-                    rotate_dynamic=False,
+                    pad_offset=(0, 0), rotate_dynamic=False,
                     band_taps=None) -> Dict[str, object]:
     """Compact human-readable program identity for the cost ledger /
     ``/debug/plans`` — which ops the program fuses and at what static
     shapes, without dumping the whole TransformPlan repr. ``kernel``
     names the resample formulation (dense | banded) so dense and banded
     ledger entries are tellable apart at a glance; banded entries also
-    carry their static per-axis band widths."""
+    carry their static per-axis band widths. Every cache-keyed,
+    trace-read component must be representable here — two programs with
+    different keys must never produce identical descriptors (the
+    flylint ``program-key-drift`` rule holds this to the cache keys
+    mechanically), which is why extent entries carry ``pad_offset`` and
+    the fill ``background`` alongside the canvas."""
     ops = []
     if resample_out is not None:
         ops.append("resample")
@@ -230,6 +235,13 @@ def plan_descriptor(plan: TransformPlan, *, in_shape=None, batch=None,
             desc["band_taps"] = list(band_taps)
     if pad_canvas is not None:
         desc["pad_canvas"] = list(pad_canvas)
+        desc["pad_offset"] = list(pad_offset)
+    if pad_canvas is not None or plan.rotate is not None:
+        # the fill color is part of the compiled program wherever a
+        # canvas (extent pad) or rotate background is painted
+        desc["background"] = (
+            list(plan.background) if plan.background is not None else None
+        )
     desc["filter"] = plan.filter_method
     return desc
 
@@ -386,18 +398,19 @@ def build_program(
         key,
         plan_descriptor(
             plan, in_shape=in_shape, resample_out=resample_out,
-            pad_canvas=pad_canvas, band_taps=band_taps,
+            pad_canvas=pad_canvas, pad_offset=pad_offset,
+            band_taps=band_taps,
         ),
     )
 
 
-def program_cache_info() -> Dict[str, object]:
+def program_cache_info() -> Dict[str, Any]:
     """Introspection over BOTH program caches (this module's single-image
     cache and the batcher's batched cache) — the source of truth the
     compile-hit accounting and the ``flyimg_program_cache_entries`` gauge
     read, instead of inferring state from miss-count deltas."""
     single = build_program.cache_info()
-    doc = {
+    doc: Dict[str, Any] = {
         "single": {
             "entries": single.currsize,
             "hits": single.hits,
@@ -503,6 +516,13 @@ def run_plan(image: np.ndarray, plan: TransformPlan) -> np.ndarray:
     else:
         padded = image
         resample_out = None
+        # DELIBERATE exact-frame path (one compile per source size):
+        # static rotate with conv post-ops must see the true frame —
+        # bucket padding would blur the background fill across the
+        # valid-region edge (visible halo), and the rotate bbox derives
+        # from the full frame. jax-retrace-hazard accepted for exactly
+        # this branch; all other shapes ride _bucket_dim above.
+        # flylint: disable=jax-retrace-hazard
         in_shape = (h, w)
 
     fn = build_program(

@@ -163,7 +163,8 @@ def build_batched_program(
         plan_descriptor(
             plan, in_shape=in_shape, batch=batch_size,
             resample_out=resample_out, pad_canvas=pad_canvas,
-            rotate_dynamic=rotate_dynamic, band_taps=band_taps,
+            pad_offset=pad_offset, rotate_dynamic=rotate_dynamic,
+            band_taps=band_taps,
         ),
     )
 
@@ -401,7 +402,13 @@ class BatchController:
             resample_out = None
             needs_slice = rotate_dynamic or in_shape != (h, w)
         else:
-            # static rotate (conv post-ops) without resample: exact frame
+            # static rotate (conv post-ops) without resample: exact
+            # frame, DELIBERATELY unbucketed — bucket padding would
+            # blur the background fill across the valid-region edge
+            # (visible halo) and the rotate bbox derives from the full
+            # frame; same accepted jax-retrace-hazard as run_plan's
+            # exact-frame branch (ops/compose.py).
+            # flylint: disable=jax-retrace-hazard
             in_shape = (h, w)
             resample_out = None
         # kernel-variant policy from the member's TRUE geometry (the

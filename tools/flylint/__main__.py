@@ -12,7 +12,12 @@ import os
 import sys
 from typing import List
 
-from tools.flylint.checkers import ALL_CHECKERS, ALL_RULES
+from tools.flylint.checkers import (
+    ALL_CHECKERS,
+    ALL_EXPLANATIONS,
+    ALL_RULES,
+    RULE_OWNERS,
+)
 from tools.flylint.core import (
     Project,
     load_baseline,
@@ -22,6 +27,55 @@ from tools.flylint.core import (
 
 DEFAULT_PATHS = ["flyimg_tpu", "tools"]
 DEFAULT_BASELINE = os.path.join("tools", "flylint", "baseline.json")
+
+
+def _print_rules() -> None:
+    """Rule catalog grouped by checker (docs/static-analysis.md mirrors
+    this listing)."""
+    by_checker: dict = {}
+    for rule in sorted(ALL_RULES):
+        by_checker.setdefault(RULE_OWNERS[rule], []).append(rule)
+    for checker in sorted(by_checker):
+        print(f"[{checker}]")
+        for rule in by_checker[checker]:
+            star = "*" if rule in ALL_EXPLANATIONS else " "
+            print(f"  {star} {rule}: {ALL_RULES[rule]}")
+    print(
+        "\n(* = detailed rationale/example available via "
+        "`python -m tools.flylint --explain <rule>`)"
+    )
+
+
+def _explain(rule: str) -> int:
+    if rule not in ALL_RULES:
+        print(f"flylint: unknown rule `{rule}`", file=sys.stderr)
+        close = [r for r in sorted(ALL_RULES) if rule in r or r in rule]
+        if close:
+            print(f"flylint: did you mean: {', '.join(close)}?",
+                  file=sys.stderr)
+        return 2
+    print(f"{rule}  [{RULE_OWNERS[rule]}]")
+    print(f"  {ALL_RULES[rule]}\n")
+    doc = ALL_EXPLANATIONS.get(rule)
+    if doc is None:
+        print(
+            "No extended explanation registered for this rule; see the "
+            "catalog in docs/static-analysis.md."
+        )
+        return 0
+    for title, field in (
+        ("Why it matters", "rationale"),
+        ("Example (trips the rule)", "example"),
+        ("Fixing / suppressing", "suppression"),
+    ):
+        body = doc.get(field)
+        if not body:
+            continue
+        print(f"{title}:")
+        for line in body.splitlines():
+            print(f"  {line}")
+        print()
+    return 0
 
 
 def main(argv: List[str] = None) -> int:
@@ -62,13 +116,24 @@ def main(argv: List[str] = None) -> int:
             "needs a justification written by hand"
         ),
     )
-    parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalog grouped by checker and exit",
+    )
+    parser.add_argument(
+        "--explain", metavar="RULE", default=None,
+        help=(
+            "print one rule's rationale, a tripping example, and its "
+            "fix/suppression guidance, then exit"
+        ),
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
-        for rule in sorted(ALL_RULES):
-            print(f"{rule}: {ALL_RULES[rule]}")
+        _print_rules()
         return 0
+    if args.explain is not None:
+        return _explain(args.explain)
 
     root = os.path.abspath(args.root)
     if not os.path.isdir(root):

@@ -10,6 +10,7 @@ pass, and a suppression case per rule).
 from tools.flylint.checkers.concurrency import ConcurrencyChecker
 from tools.flylint.checkers.jax_hazards import JaxHazardsChecker
 from tools.flylint.checkers.observability import ObservabilityChecker
+from tools.flylint.checkers.program_identity import ProgramIdentityChecker
 from tools.flylint.checkers.registry import RegistryChecker
 
 ALL_CHECKERS = (
@@ -17,6 +18,7 @@ ALL_CHECKERS = (
     RegistryChecker(),
     JaxHazardsChecker(),
     ObservabilityChecker(),
+    ProgramIdentityChecker(),
 )
 
 ALL_RULES = {
@@ -25,4 +27,19 @@ ALL_RULES = {
     for rule, desc in checker.rules.items()
 }
 
-__all__ = ["ALL_CHECKERS", "ALL_RULES"]
+#: rule -> checker name (for --list-rules grouping)
+RULE_OWNERS = {
+    rule: checker.name
+    for checker in ALL_CHECKERS
+    for rule in checker.rules
+}
+
+#: rule -> {rationale, example, suppression} where a checker provides it
+#: (``python -m tools.flylint --explain <rule>``)
+ALL_EXPLANATIONS = {
+    rule: doc
+    for checker in ALL_CHECKERS
+    for rule, doc in getattr(checker, "explanations", {}).items()
+}
+
+__all__ = ["ALL_CHECKERS", "ALL_RULES", "RULE_OWNERS", "ALL_EXPLANATIONS"]

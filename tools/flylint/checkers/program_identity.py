@@ -1,0 +1,878 @@
+"""Program-identity dataflow: prove cache-key completeness for every
+traced program.
+
+The serving stack carries THREE parallel identity systems for one device
+program — the lru program-cache keys (``ops/compose.build_program``,
+``runtime/batcher.build_batched_program``), the batcher's ``submit()``
+group key (which requests may share a launch), and the cost-ledger
+``plan_descriptor`` (what ``/debug/plans`` says a program is). Every
+value the traced ``program()`` body closes over is a compile-time
+constant of the executable: if it can vary between requests but is
+missing from a key, two different programs collide in the cache and the
+second request silently gets the first's pixels (the classic
+JIT-serving wrong-answer mode — "Beyond Inference", arXiv 2403.12981);
+if a key carries a component the trace never reads, equal programs
+fragment into needless recompiles. PR 8 threaded ``band_taps`` through
+all three systems by hand; this checker makes that discipline
+mechanical:
+
+- **program-key-incomplete** — a value read inside the traced program
+  body (a closure-captured factory parameter, or a ``plan.<attr>`` the
+  program reads but ``TransformPlan.device_plan`` normalizes away) is
+  absent from the builder's cache key.
+- **program-key-overspecified** — a cache-key element maps to a factory
+  parameter the traced body never reads (or to nothing at all), so it
+  only fragments the cache.
+- **program-key-drift** — the three systems disagree on membership: the
+  batch group key vs the batched program-cache key, or a keyed/traced
+  component the ledger descriptor does not serialize (two distinct
+  programs become indistinguishable in ``/debug/plans``).
+- **jax-retrace-hazard** — a per-request-derived value (anything
+  computed from ``<image>.shape``) reaches a static program-identity
+  slot (a builder argument or key element) without passing one of the
+  bucketing helpers (``_bucket_dim``, ``bucket_taps``, ``bucket_batch``,
+  ``_round_batch``, ``select_band_taps``) — the compile-storm mode the
+  runtime retrace sentinel (``tools/flylint/retrace_sentinel.py``)
+  catches dynamically.
+
+Resolution is dataflow over the real call structure, not name matching:
+builder key elements are matched (by AST equality) against the
+expressions the builder passes to the factory; the batcher group key is
+resolved key-element -> ``_Group`` field (via the constructor call) ->
+builder parameter (via the ``build_batched_program(group.<field>, ...)``
+launch call) -> factory parameter. Literal tags and shape/batch/mesh
+specialization keys (which select the *shapes* the trace specializes on
+rather than closure constants) are identity-by-construction and exempt
+from the overspecified/drift rules.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.flylint.core import Finding, Project
+
+RULE_INCOMPLETE = "program-key-incomplete"
+RULE_OVERSPECIFIED = "program-key-overspecified"
+RULE_DRIFT = "program-key-drift"
+RULE_RETRACE = "jax-retrace-hazard"
+
+#: builder arguments that specialize the trace by SHAPE (the jit keys on
+#: argument shapes itself) or by backend placement rather than by a
+#: closure constant — exempt from overspecified/drift membership checks
+_SHAPE_KEY_RE = re.compile(r"(shape|batch|mesh|size|bucket)", re.I)
+
+
+def _dotted(node: ast.AST) -> str:
+    if isinstance(node, ast.Attribute):
+        return f"{_dotted(node.value)}.{node.attr}"
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _call_name(node: ast.Call) -> str:
+    """Trailing name of the callee: ``a.b.f(...)`` -> ``f``."""
+    return _dotted(node.func).split(".")[-1]
+
+
+def _unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse is total on 3.9+
+        return ast.dump(node)
+
+
+def _expr_key(node: ast.AST) -> str:
+    """Structural identity for matching one expression across two
+    sites (key element vs factory argument)."""
+    return ast.dump(node)
+
+
+@dataclass
+class _FactoryInfo:
+    """``make_program_fn``-shaped factory: params, and what the nested
+    traced ``program()`` body actually reads."""
+
+    src: object                      # SourceFile
+    node: ast.FunctionDef
+    symbol: str
+    params: List[str] = field(default_factory=list)
+    traced_params: Set[str] = field(default_factory=set)
+    plan_attrs: Dict[str, int] = field(default_factory=dict)  # attr -> line
+    plan_param: Optional[str] = None
+
+
+@dataclass
+class _BuilderInfo:
+    """A cached builder: calls the factory, assigns a ``key`` tuple."""
+
+    src: object
+    node: ast.FunctionDef
+    symbol: str
+    # factory param -> the argument expression the builder passes
+    factory_args: Dict[str, ast.AST] = field(default_factory=dict)
+    # own parameter name -> factory param (for Name arguments)
+    param_to_factory: Dict[str, str] = field(default_factory=dict)
+    key_node: Optional[ast.Assign] = None
+    key_components: Set[str] = field(default_factory=set)  # factory params
+
+
+class ProgramIdentityChecker:
+    """Cache-key completeness for traced device programs."""
+
+    name = "program-identity"
+
+    FACTORY = "make_program_fn"
+    DESCRIPTOR = "plan_descriptor"
+    PLAN_PARAM = "plan"
+    DEVICE_PLAN = "device_plan"
+    SANITIZERS = frozenset({
+        "_bucket_dim", "bucket_taps", "bucket_batch", "_round_batch",
+        "select_band_taps",
+    })
+
+    rules = {
+        RULE_INCOMPLETE: (
+            "a value the traced program body reads is missing from its "
+            "program-cache key (silent wrong-variant cache hits)"
+        ),
+        RULE_OVERSPECIFIED: (
+            "a program-cache key field the traced body never reads "
+            "(needless cache fragmentation and recompiles)"
+        ),
+        RULE_DRIFT: (
+            "the program-cache key, batch group key, and ledger "
+            "descriptor disagree on identity membership"
+        ),
+        RULE_RETRACE: (
+            "a per-request-derived value reaches a static program-"
+            "identity slot without a bucketing helper (compile storm)"
+        ),
+    }
+
+    explanations = {
+        RULE_INCOMPLETE: {
+            "rationale": (
+                "Every closure-captured value and plan attribute the "
+                "traced program() body reads is baked into the compiled "
+                "executable. If it can differ between two requests but "
+                "is absent from the cache key (or zeroed by "
+                "TransformPlan.device_plan), both requests hash to one "
+                "cache entry and the second silently runs the first's "
+                "program — wrong pixels, no error."
+            ),
+            "example": (
+                "def build(in_shape, plan, band_taps):\n"
+                "    key = ('single', in_shape, plan)   # band_taps "
+                "missing\n"
+                "    return jit(make_program_fn(plan, band_taps))"
+            ),
+            "suppression": (
+                "Add the component to the key. Suppress only when the "
+                "value is provably process-constant for the builder's "
+                "lifetime, and say why inline."
+            ),
+        },
+        RULE_OVERSPECIFIED: {
+            "rationale": (
+                "A key field the traced body never reads cannot change "
+                "the compiled program — it only splits one program into "
+                "many cache entries, each paying a fresh XLA compile "
+                "(the compile-storm half of the failure mode)."
+            ),
+            "example": (
+                "def build(in_shape, plan, quality):\n"
+                "    key = ('single', in_shape, plan, quality)  # "
+                "quality is host-side only\n"
+                "    return jit(make_program_fn(plan))"
+            ),
+            "suppression": (
+                "Drop the field from the key, or route the value into "
+                "the traced body if it was meant to matter. Shape/batch/"
+                "mesh specialization keys are already exempt."
+            ),
+        },
+        RULE_DRIFT: {
+            "rationale": (
+                "Three systems share the program-identity vocabulary: "
+                "program-cache keys (which executable), submit() group "
+                "keys (which requests may share a batch), and "
+                "plan_descriptor (what /debug/plans reports). A "
+                "component present in one and missing in another means "
+                "requests batch across distinct programs (assembly "
+                "crash or wrong pixels) or distinct programs become "
+                "indistinguishable in the cost ledger."
+            ),
+            "example": (
+                "key = (in_shape, device_plan, rotate_dynamic)  # "
+                "group key lost band_taps\n"
+                "# ...while build_batched_program still keys and "
+                "traces band_taps"
+            ),
+            "suppression": (
+                "Thread the component through all three systems (see "
+                "docs/kernels.md 'Program identity'). Suppress only "
+                "for components that are genuinely launch-resolved."
+            ),
+        },
+        RULE_RETRACE: {
+            "rationale": (
+                "Static builder arguments and key elements select a "
+                "compiled executable; feeding them raw per-request "
+                "values (source dims from image.shape) compiles one "
+                "program per distinct request — a compile storm that "
+                "serializes the serving path behind XLA. The bucketing "
+                "helpers (_bucket_dim, bucket_taps, bucket_batch, "
+                "_round_batch, select_band_taps) exist to bound the "
+                "variant count."
+            ),
+            "example": (
+                "h, w = image.shape[0], image.shape[1]\n"
+                "in_shape = (h, w)          # unbucketed\n"
+                "fn = build_program(in_shape, ...)"
+            ),
+            "suppression": (
+                "Route the value through a bucketing helper. Suppress "
+                "inline only for a deliberate exact-shape path, with "
+                "the correctness reason (e.g. the static-rotate "
+                "edge-halo rationale) next to the assignment."
+            ),
+        },
+    }
+
+    # ------------------------------------------------------------------
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        factory = self._find_factory(project)
+        if factory is None:
+            return
+        zeroed = self._device_plan_zeroed(project)
+        yield from self._check_device_plan_reads(factory, zeroed)
+        builders = self._find_builders(project, factory)
+        for builder in builders:
+            yield from self._check_builder(builder, factory)
+        descriptor = self._find_descriptor(project)
+        group_keys = list(self._find_group_keys(project, builders))
+        for src, fn, key_assign, components, builder in group_keys:
+            yield from self._check_group_drift(
+                src, fn, key_assign, components, builder
+            )
+        if descriptor is not None:
+            yield from self._check_descriptor_drift(
+                descriptor, factory, builders
+            )
+        yield from self._check_retrace_hazards(project, builders, factory)
+
+    # -- discovery -----------------------------------------------------
+
+    def _functions(self, src) -> Iterable[Tuple[str, ast.FunctionDef]]:
+        """Every (symbol, FunctionDef) in one file, with Class.method
+        symbols."""
+        def walk(node, prefix):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    symbol = (
+                        f"{prefix}.{child.name}" if prefix else child.name
+                    )
+                    yield symbol, child
+                    yield from walk(child, symbol)
+                elif isinstance(child, ast.ClassDef):
+                    symbol = (
+                        f"{prefix}.{child.name}" if prefix else child.name
+                    )
+                    yield from walk(child, symbol)
+
+        if src.tree is None:
+            return
+        yield from walk(src.tree, "")
+
+    def _find_factory(self, project: Project) -> Optional[_FactoryInfo]:
+        for src in project.files:
+            for symbol, fn in self._functions(src):
+                if fn.name == self.FACTORY:
+                    return self._analyze_factory(src, fn, symbol)
+        return None
+
+    def _analyze_factory(self, src, fn: ast.FunctionDef,
+                         symbol: str) -> _FactoryInfo:
+        info = _FactoryInfo(src=src, node=fn, symbol=symbol)
+        args = fn.args
+        info.params = [
+            a.arg for a in args.posonlyargs + args.args + args.kwonlyargs
+        ]
+        if self.PLAN_PARAM in info.params:
+            info.plan_param = self.PLAN_PARAM
+        # factory-local assignments before/around the nested def: a name
+        # derived from params carries those params' identity into the
+        # traced body when the body reads it
+        local_exprs: Dict[str, ast.AST] = {}
+        nested: Optional[ast.FunctionDef] = None
+        for child in fn.body:
+            if isinstance(child, ast.Assign) and len(child.targets) == 1:
+                target = child.targets[0]
+                if isinstance(target, ast.Name):
+                    local_exprs[target.id] = child.value
+            if isinstance(child, ast.FunctionDef) and nested is None:
+                nested = child
+        if nested is None:
+            return info
+        # names the program body BINDS are its own locals, not captures
+        bound: Set[str] = {
+            a.arg for a in (
+                nested.args.posonlyargs + nested.args.args
+                + nested.args.kwonlyargs
+            )
+        }
+        for node in ast.walk(nested):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    for sub in ast.walk(t):
+                        if isinstance(sub, ast.Name):
+                            bound.add(sub.id)
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                for alias in node.names:
+                    bound.add(alias.asname or alias.name.split(".")[0])
+            elif isinstance(node, (ast.For, ast.comprehension)):
+                t = node.target
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Name):
+                        bound.add(sub.id)
+
+        def note_read(name: str, line: int) -> None:
+            if name in info.params:
+                info.traced_params.add(name)
+            elif name in local_exprs:
+                # one-hop resolution of a factory-local derived value
+                for sub in ast.walk(local_exprs[name]):
+                    if isinstance(sub, ast.Name) and sub.id in info.params:
+                        info.traced_params.add(sub.id)
+                    if (
+                        isinstance(sub, ast.Attribute)
+                        and isinstance(sub.value, ast.Name)
+                        and sub.value.id == info.plan_param
+                    ):
+                        info.plan_attrs.setdefault(sub.attr, line)
+
+        for node in ast.walk(nested):
+            if isinstance(node, ast.Attribute) and isinstance(
+                node.value, ast.Name
+            ):
+                if (
+                    node.value.id == info.plan_param
+                    and info.plan_param is not None
+                ):
+                    info.plan_attrs.setdefault(node.attr, node.lineno)
+            elif isinstance(node, ast.Name) and isinstance(
+                node.ctx, ast.Load
+            ):
+                if node.id not in bound:
+                    note_read(node.id, node.lineno)
+        # reading any plan attr means the plan param is traced
+        if info.plan_attrs and info.plan_param is not None:
+            info.traced_params.add(info.plan_param)
+        return info
+
+    def _device_plan_zeroed(self, project: Project) -> Set[str]:
+        """Plan fields ``device_plan`` normalizes to constants — fields
+        the cache key can no longer tell apart."""
+        for src in project.files:
+            for _symbol, fn in self._functions(src):
+                if fn.name != self.DEVICE_PLAN:
+                    continue
+                for node in ast.walk(fn):
+                    if (
+                        isinstance(node, ast.Call)
+                        and _call_name(node) == "replace"
+                    ):
+                        return {
+                            kw.arg for kw in node.keywords
+                            if kw.arg is not None
+                        }
+        return set()
+
+    def _find_builders(self, project: Project,
+                       factory: _FactoryInfo) -> List[_BuilderInfo]:
+        builders: List[_BuilderInfo] = []
+        for src in project.files:
+            for symbol, fn in self._functions(src):
+                if fn.name == self.FACTORY:
+                    continue
+                call = None
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Call) and (
+                        _call_name(node) == self.FACTORY
+                    ):
+                        call = node
+                        break
+                if call is None:
+                    continue
+                info = _BuilderInfo(src=src, node=fn, symbol=symbol)
+                self._bind_factory_args(info, call, factory)
+                info.key_node = self._key_assignment(fn)
+                if info.key_node is not None:
+                    builders.append(info)
+        return builders
+
+    def _bind_factory_args(self, info: _BuilderInfo, call: ast.Call,
+                           factory: _FactoryInfo) -> None:
+        own_params = {
+            a.arg for a in (
+                info.node.args.posonlyargs + info.node.args.args
+                + info.node.args.kwonlyargs
+            )
+        }
+        for i, arg in enumerate(call.args):
+            if i < len(factory.params):
+                info.factory_args[factory.params[i]] = arg
+        for kw in call.keywords:
+            if kw.arg is not None:
+                info.factory_args[kw.arg] = kw.value
+        for param, expr in info.factory_args.items():
+            if isinstance(expr, ast.Name) and expr.id in own_params:
+                info.param_to_factory[expr.id] = param
+
+    @staticmethod
+    def _key_assignment(fn: ast.FunctionDef) -> Optional[ast.Assign]:
+        """First ``key = (<tuple literal>)`` assignment in the function
+        (``*_key`` names count; later non-literal reassembly like the
+        quarantine nonce suffix does not)."""
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            target = node.targets[0]
+            if not isinstance(target, ast.Name):
+                continue
+            if not (target.id == "key" or target.id.endswith("_key")):
+                continue
+            if isinstance(node.value, ast.Tuple):
+                return node
+        return None
+
+    def _find_descriptor(self, project: Project):
+        for src in project.files:
+            for symbol, fn in self._functions(src):
+                if fn.name == self.DESCRIPTOR:
+                    return (src, fn, symbol)
+        return None
+
+    # -- builder checks ------------------------------------------------
+
+    def _check_builder(self, builder: _BuilderInfo,
+                       factory: _FactoryInfo) -> Iterable[Finding]:
+        assert builder.key_node is not None
+        key_tuple = builder.key_node.value
+        arg_dumps = {
+            _expr_key(expr): param
+            for param, expr in builder.factory_args.items()
+        }
+        for elt in key_tuple.elts:
+            if isinstance(elt, ast.Constant):
+                continue  # literal tag
+            param = arg_dumps.get(_expr_key(elt))
+            if param is not None:
+                builder.key_components.add(param)
+                if param not in factory.traced_params:
+                    yield Finding(
+                        rule=RULE_OVERSPECIFIED,
+                        path=builder.src.relpath,
+                        line=elt.lineno,
+                        symbol=builder.symbol,
+                        message=(
+                            f"key field `{_unparse(elt)}` maps to factory "
+                            f"parameter `{param}` which the traced "
+                            "program body never reads — it only "
+                            "fragments the program cache"
+                        ),
+                    )
+                continue
+            text = _unparse(elt)
+            if _SHAPE_KEY_RE.search(text):
+                continue  # shape/batch/mesh specialization key
+            yield Finding(
+                rule=RULE_OVERSPECIFIED,
+                path=builder.src.relpath,
+                line=elt.lineno,
+                symbol=builder.symbol,
+                message=(
+                    f"key field `{text}` matches no traced factory "
+                    "argument and no shape/batch/mesh specialization — "
+                    "it cannot change the compiled program"
+                ),
+            )
+        # incomplete: every traced, non-constant factory arg must be
+        # serialized into the key
+        for param, expr in builder.factory_args.items():
+            if param not in factory.traced_params:
+                continue
+            if isinstance(expr, ast.Constant):
+                continue  # pinned constant: not a varying component
+            if param in builder.key_components:
+                continue
+            yield Finding(
+                rule=RULE_INCOMPLETE,
+                path=builder.src.relpath,
+                line=builder.key_node.lineno,
+                symbol=builder.symbol,
+                message=(
+                    f"traced program input `{param}` (passed to "
+                    f"{self.FACTORY} as `{_unparse(expr)}`) is missing "
+                    "from the program-cache key — two variants would "
+                    "collide on one cache entry"
+                ),
+            )
+
+    def _check_device_plan_reads(self, factory: _FactoryInfo,
+                                 zeroed: Set[str]) -> Iterable[Finding]:
+        for attr in sorted(factory.plan_attrs):
+            if attr in zeroed:
+                yield Finding(
+                    rule=RULE_INCOMPLETE,
+                    path=factory.src.relpath,
+                    line=factory.plan_attrs[attr],
+                    symbol=factory.symbol,
+                    message=(
+                        f"traced read `plan.{attr}` is normalized away "
+                        f"by TransformPlan.{self.DEVICE_PLAN} — the "
+                        "cache key cannot distinguish variants that "
+                        "differ in it"
+                    ),
+                )
+
+    # -- group key -----------------------------------------------------
+
+    def _builder_attr_map(self, project: Project,
+                          builders: List[_BuilderInfo],
+                          ) -> Dict[str, Tuple[_BuilderInfo, str]]:
+        """``<obj>.<field>`` arguments at builder call sites, resolved
+        to the builder's factory components: field -> (builder, factory
+        param)."""
+        by_name = {b.node.name: b for b in builders}
+        out: Dict[str, Tuple[_BuilderInfo, str]] = {}
+        for src in project.files:
+            if src.tree is None:
+                continue
+            for node in ast.walk(src.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                builder = by_name.get(_call_name(node))
+                if builder is None:
+                    continue
+                params = [
+                    a.arg for a in (
+                        builder.node.args.posonlyargs
+                        + builder.node.args.args
+                        + builder.node.args.kwonlyargs
+                    )
+                ]
+                bound: List[Tuple[str, ast.AST]] = list(
+                    zip(params, node.args)
+                )
+                bound += [
+                    (kw.arg, kw.value) for kw in node.keywords
+                    if kw.arg is not None
+                ]
+                for pname, expr in bound:
+                    factory_param = builder.param_to_factory.get(pname)
+                    if factory_param is None:
+                        continue
+                    if isinstance(expr, ast.Attribute) and isinstance(
+                        expr.value, ast.Name
+                    ):
+                        out[expr.attr] = (builder, factory_param)
+        return out
+
+    def _find_group_keys(self, project: Project,
+                         builders: List[_BuilderInfo]):
+        """(src, fn, key assignment, resolved components) for functions
+        that build a group key: a ``key`` tuple whose elements resolve —
+        through a constructor's keyword arguments — to fields that feed
+        a builder's factory parameters at some call site."""
+        attr_map = self._builder_attr_map(project, builders)
+        if not attr_map:
+            return
+        builder_fns = {b.node for b in builders}
+        for src in project.files:
+            for _symbol, fn in self._functions(src):
+                if fn in builder_fns:
+                    continue
+                key_assign = self._key_assignment(fn)
+                if key_assign is None:
+                    continue
+                # constructor kwargs: expression dump -> field name
+                ctor_fields: Dict[str, str] = {}
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Call):
+                        for kw in node.keywords:
+                            if kw.arg in attr_map:
+                                ctor_fields[_expr_key(kw.value)] = kw.arg
+                if not ctor_fields:
+                    continue
+                components: Dict[str, int] = {}
+                resolved = 0
+                via_builder: Dict[object, int] = {}
+                for elt in key_assign.value.elts:
+                    if isinstance(elt, ast.Constant):
+                        continue
+                    fieldname = ctor_fields.get(_expr_key(elt))
+                    if fieldname is None:
+                        continue
+                    builder, factory_param = attr_map[fieldname]
+                    via_builder[id(builder)] = (
+                        via_builder.get(id(builder), 0) + 1
+                    )
+                    components[factory_param] = elt.lineno
+                    resolved += 1
+                if resolved >= 3:
+                    # the builder this group actually feeds: the one the
+                    # resolved fields reach at the launch call site
+                    by_id = {id(b): b for b in builders}
+                    builder = by_id[max(via_builder, key=via_builder.get)]
+                    yield src, fn, key_assign, components, builder
+
+    def _check_group_drift(self, src, fn, key_assign,
+                           components: Dict[str, int],
+                           best: _BuilderInfo) -> Iterable[Finding]:
+        """Group-key membership vs the cache key of the builder the
+        group feeds at launch time, over factory-bound components only
+        (shape/batch/mesh keys are launch-resolved and exempt)."""
+        symbol = ""
+        for sym, f in self._functions(src):
+            if f is fn:
+                symbol = sym
+                break
+        for param in sorted(best.key_components - set(components)):
+            expr = best.factory_args.get(param)
+            if expr is not None and isinstance(expr, ast.Constant):
+                continue
+            yield Finding(
+                rule=RULE_DRIFT,
+                path=src.relpath,
+                line=key_assign.lineno,
+                symbol=symbol,
+                message=(
+                    f"group key omits `{param}` while the program cache "
+                    f"({best.symbol}) keys on it — requests with "
+                    "different values would share a batch across "
+                    "distinct programs"
+                ),
+            )
+        for param in sorted(set(components) - best.key_components):
+            yield Finding(
+                rule=RULE_DRIFT,
+                path=best.src.relpath,
+                line=(
+                    best.key_node.lineno
+                    if best.key_node is not None else best.node.lineno
+                ),
+                symbol=best.symbol,
+                message=(
+                    f"program-cache key omits `{param}` while the group "
+                    f"key ({src.relpath}) carries it — equal programs "
+                    "fragment into separate groups, or distinct "
+                    "programs collide in the cache"
+                ),
+            )
+
+    # -- descriptor ----------------------------------------------------
+
+    def _check_descriptor_drift(self, descriptor, factory: _FactoryInfo,
+                                builders: List[_BuilderInfo],
+                                ) -> Iterable[Finding]:
+        src, fn, symbol = descriptor
+        params = {
+            a.arg for a in (
+                fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs
+            )
+        }
+        read_params: Set[str] = set()
+        read_plan_attrs: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and isinstance(
+                node.ctx, ast.Load
+            ):
+                if node.id in params:
+                    read_params.add(node.id)
+            elif isinstance(node, ast.Attribute) and isinstance(
+                node.value, ast.Name
+            ):
+                if node.value.id == self.PLAN_PARAM:
+                    read_plan_attrs.add(node.attr)
+        # every traced, cache-keyed component must be representable in
+        # the ledger descriptor — otherwise two distinct programs are
+        # indistinguishable in /debug/plans
+        keyed: Set[str] = set()
+        for b in builders:
+            for param in b.key_components:
+                expr = b.factory_args.get(param)
+                if expr is not None and not isinstance(expr, ast.Constant):
+                    keyed.add(param)
+        for param in sorted(keyed & factory.traced_params):
+            if param == factory.plan_param:
+                continue  # covered by the per-attr check below
+            if param not in read_params:
+                yield Finding(
+                    rule=RULE_DRIFT,
+                    path=src.relpath,
+                    line=fn.lineno,
+                    symbol=symbol,
+                    message=(
+                        f"ledger descriptor `{self.DESCRIPTOR}` never "
+                        f"reads keyed program component `{param}` — "
+                        "distinct programs become indistinguishable in "
+                        "/debug/plans"
+                    ),
+                )
+        for attr in sorted(set(factory.plan_attrs) - read_plan_attrs):
+            yield Finding(
+                rule=RULE_DRIFT,
+                path=src.relpath,
+                line=fn.lineno,
+                symbol=symbol,
+                message=(
+                    f"ledger descriptor `{self.DESCRIPTOR}` never reads "
+                    f"`plan.{attr}` although the traced program does — "
+                    "programs differing in it look identical in "
+                    "/debug/plans"
+                ),
+            )
+
+    # -- retrace hazards -----------------------------------------------
+
+    def _check_retrace_hazards(self, project: Project,
+                               builders: List[_BuilderInfo],
+                               factory: _FactoryInfo) -> Iterable[Finding]:
+        builder_names = {b.node.name for b in builders} | {self.FACTORY}
+        for src in project.files:
+            for symbol, fn in self._functions(src):
+                if fn.name in builder_names:
+                    continue
+                # scope: functions that reach static identity — a
+                # builder call or a key-tuple assignment
+                calls = [
+                    n for n in ast.walk(fn)
+                    if isinstance(n, ast.Call)
+                    and _call_name(n) in builder_names
+                ]
+                key_assign = self._key_assignment(fn)
+                if not calls and key_assign is None:
+                    continue
+                yield from self._taint_function(
+                    src, symbol, fn, calls, key_assign
+                )
+
+    def _taint_function(self, src, symbol: str, fn: ast.FunctionDef,
+                        calls: List[ast.Call],
+                        key_assign: Optional[ast.Assign],
+                        ) -> Iterable[Finding]:
+        # assignments: name -> [(line, value expr)]
+        assigns: Dict[str, List[Tuple[int, ast.AST]]] = {}
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            targets = node.targets
+            if len(targets) == 1 and isinstance(targets[0], ast.Tuple) \
+                    and isinstance(node.value, ast.Tuple) \
+                    and len(targets[0].elts) == len(node.value.elts):
+                for t, v in zip(targets[0].elts, node.value.elts):
+                    if isinstance(t, ast.Name):
+                        assigns.setdefault(t.id, []).append((v.lineno, v))
+            else:
+                for t in targets:
+                    for sub in ast.walk(t):
+                        if isinstance(sub, ast.Name):
+                            assigns.setdefault(sub.id, []).append(
+                                (node.value.lineno, node.value)
+                            )
+
+        tainted: Set[str] = set()
+
+        def expr_tainted(node: ast.AST) -> bool:
+            if isinstance(node, ast.Call):
+                if _call_name(node) in self.SANITIZERS:
+                    return False  # bucketing helper: cleansed
+                return any(
+                    expr_tainted(a) for a in node.args
+                ) or any(expr_tainted(kw.value) for kw in node.keywords)
+            if isinstance(node, ast.Attribute) and node.attr == "shape":
+                return True  # per-request source dims
+            if isinstance(node, ast.Name):
+                return node.id in tainted
+            return any(
+                expr_tainted(child) for child in ast.iter_child_nodes(node)
+            )
+
+        # fixpoint over the (tiny) per-function assignment graph
+        changed = True
+        while changed:
+            changed = False
+            for name, values in assigns.items():
+                if name in tainted:
+                    continue
+                if any(expr_tainted(v) for _line, v in values):
+                    tainted.add(name)
+                    changed = True
+
+        sinks: List[ast.AST] = []
+        for call in calls:
+            sinks.extend(call.args)
+            sinks.extend(kw.value for kw in call.keywords)
+        if key_assign is not None:
+            sinks.extend(key_assign.value.elts)
+
+        reported: Set[Tuple[str, int]] = set()
+        for sink in sinks:
+            if not expr_tainted(sink):
+                continue
+            # blame the tainted ASSIGNMENT (suppression locality): the
+            # sink names which identity slot it reaches
+            names = [
+                n.id for n in ast.walk(sink)
+                if isinstance(n, ast.Name) and n.id in tainted
+            ]
+            if not names:
+                # taint is inline in the sink expression itself
+                mark = ("<inline>", sink.lineno)
+                if mark not in reported:
+                    reported.add(mark)
+                    yield Finding(
+                        rule=RULE_RETRACE, path=src.relpath,
+                        line=sink.lineno, symbol=symbol,
+                        message=(
+                            f"per-request-derived `{_unparse(sink)}` "
+                            "reaches static program identity without a "
+                            "bucketing helper — one compile per "
+                            "distinct request"
+                        ),
+                    )
+                continue
+            for name in names:
+                for line, value in assigns.get(name, []):
+                    if not expr_tainted(value):
+                        continue
+                    mark = (name, line)
+                    if mark in reported:
+                        continue
+                    reported.add(mark)
+                    yield Finding(
+                        rule=RULE_RETRACE, path=src.relpath, line=line,
+                        symbol=symbol,
+                        message=(
+                            f"`{name}` is assigned from per-request "
+                            f"source dims (`{_unparse(value)}`) and "
+                            "reaches static program identity "
+                            f"(`{_unparse(sink)[:60]}`) without a "
+                            "bucketing helper (_bucket_dim/bucket_taps/"
+                            "select_band_taps) — one compile per "
+                            "distinct request"
+                        ),
+                    )
