@@ -226,6 +226,31 @@ SERVER_DEFAULTS: Dict[str, Any] = {
     # bound on queued background refreshes (over it, refreshes drop —
     # the refresh queue must not amplify the overload it exists to ride)
     "brownout_refresh_max_pending": 8,
+    # --- derivative-reuse rendering (runtime/variantindex.py +
+    # service/handler.py; docs/caching.md). Default OFF: with
+    # reuse_enable false the serving path is byte-for-byte today's
+    # behavior — no index lookups, no manifests, no new headers
+    # (pinned by tests/test_reuse.py) ---
+    # master switch for the per-source variant index + cache-aware plan
+    # rewriter (serve small renditions from cached larger ones)
+    "reuse_enable": False,
+    # a cached ancestor must be >= this multiple of the target's
+    # resample box on BOTH axes (the ">=2x so the ancestor's resample is
+    # never quality-determining" rule, same as the JPEG DCT prescale)
+    "reuse_min_scale": 2.0,
+    # bound on lossy re-encode depth along a reuse chain: an ancestor at
+    # or past this many lossy generations is never reused
+    "reuse_max_generations": 1,
+    # DEGRADED+ widening (brownout compounding, docs/degradation.md):
+    # the scale floor the rewriter accepts under pressure (plus one
+    # extra lossy generation)
+    "reuse_degraded_min_scale": 1.3,
+    # variant-index bounds: tracked sources (LRU evicted), reuse-safe
+    # renditions kept per source (smallest evicted), and the in-memory
+    # TTL after which an entry re-reads its storage manifest
+    "reuse_index_max_sources": 512,
+    "reuse_index_max_variants": 16,
+    "reuse_index_ttl_s": 3600.0,
     # --- negative origin cache (runtime/brownout.py NegativeCache) ---
     # seconds a failing origin (retry-exhausted transient errors, open
     # breaker) short-circuits repeat fetches of the same host+path to an

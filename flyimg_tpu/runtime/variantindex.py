@@ -1,0 +1,380 @@
+"""Per-source variant index: the lookup table behind derivative-reuse
+rendering (docs/caching.md; ROADMAP item 2, the PATCHEDSERVE
+hybrid-resolution idea from arXiv 2501.09253 mapped onto an image CDN).
+
+The output cache is keyed by the exact derived name (md5 of option
+values + URL), so the hottest real-traffic pattern — ONE source requested
+at many sizes — gets zero reuse: every size is a full origin-fetch +
+decode + device render. This table closes that gap. It maps a *source
+digest* (the L1 original-cache key, ``OptionsBag.hash_original_image_url``)
+to the **reuse-safe renditions** of that source already sitting in the
+output cache, with the geometry/quality/plan facts the cache-aware
+rewriter (``spec.plan.rewrite_for_reuse``) needs to decide whether a new,
+smaller request can re-derive from a cached ancestor's pixels instead of
+the origin bytes.
+
+Only *pure* renditions are indexed — full-frame resamples with no
+extract/extent/rotate/value ops/post passes (``VariantFacts.pure``);
+anything else can never serve as an ancestor, and skipping it keeps the
+table and its manifests small under crop-heavy traffic.
+
+Bounds and lifetime:
+
+- per-source variant bound (``reuse_index_max_variants``): smallest
+  rendition evicted first — the largest ancestors are the universal ones
+  (a mipmap chain keeps its top);
+- source bound (``reuse_index_max_sources``): least-recently-used source
+  evicted;
+- TTL (``reuse_index_ttl_s``): a stale in-memory entry is re-read from
+  its storage manifest, so replicas converge on what storage actually
+  holds.
+
+Persistence: every record/discard writes a small JSON **manifest**
+(``<source-digest>.variants.json``) next to the outputs, best-effort and
+OUTSIDE the table lock. A cold process (restart, second replica) lazily
+rebuilds a source's entry from that manifest on first lookup — the index
+is a cache of storage state, never the source of truth: a missing or
+corrupt manifest only costs reuse misses, and an indexed ancestor whose
+bytes were pruned is validated (and dropped) by the handler at read time.
+
+Thread-safe; storage IO never runs under the table lock. Everything here
+is inert unless ``reuse_enable`` is on (service/handler.py neither
+records nor looks up otherwise — byte-identical off behavior is pinned
+by tests/test_reuse.py).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import asdict, dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+LOGGER = "flyimg.reuse"
+
+#: manifest format version (bumped on incompatible fact-schema changes;
+#: a newer-versioned manifest is ignored, which only costs reuse misses)
+MANIFEST_VERSION = 1
+
+#: negative lookups (no manifest in storage) are remembered briefly so a
+#: miss storm for an unindexed source doesn't pay a storage read per
+#: request; kept short because the very next store creates the entry
+NEGATIVE_TTL_S = 30.0
+
+
+def manifest_name(source_key: str) -> str:
+    """Storage object name of a source's variant manifest (lives next to
+    the outputs; content-addressed by the same source digest as the L1
+    original cache)."""
+    return f"{source_key}.variants.json"
+
+
+@dataclass(frozen=True)
+class VariantFacts:
+    """Everything the reuse rewriter needs to know about one cached
+    rendition without reading its bytes. ``pure`` marks a reuse-safe
+    ancestor: a full-frame resample with no extract/extent/rotate/value
+    ops/post passes baked in (spec.plan.rewrite_for_reuse's safety rules
+    consume these fields)."""
+
+    name: str                                   # derived output-cache key
+    out_w: int                                  # stored pixel dims
+    out_h: int
+    extension: str                              # png | jpg | webp
+    quality: int                                # effective encode quality
+    lossy: bool                                 # jpg, or webp w/o webpl_1
+    pure: bool
+    colorspace: Optional[str]                   # plan.colorspace at render
+    monochrome: bool
+    background: Optional[Tuple[int, int, int]]
+    generations: int                            # lossy re-encode depth
+    src_w: int                                  # decoded source dims the
+    src_h: int                                  # render's plan was built on
+    frame_key: str                              # page/density/time/gif-frame
+    stored_at: float = 0.0
+
+    @property
+    def area(self) -> int:
+        return self.out_w * self.out_h
+
+
+@dataclass
+class SourceEntry:
+    """Immutable lookup snapshot for one source (handed to the handler
+    outside the index lock)."""
+
+    source_key: str
+    source_mime: str
+    variants: Tuple[VariantFacts, ...] = ()
+
+    def candidates(self) -> List[VariantFacts]:
+        """Reuse-safe ancestors, largest pixel area first (the biggest
+        cached rendition is the safest and highest-quality parent)."""
+        return sorted(
+            (v for v in self.variants if v.pure),
+            key=lambda v: v.area,
+            reverse=True,
+        )
+
+
+@dataclass
+class _SourceState:
+    """Mutable per-source record behind the lock."""
+
+    source_mime: str
+    variants: Dict[str, VariantFacts] = field(default_factory=dict)
+    loaded_at: float = 0.0
+    negative: bool = False  # "no manifest in storage" memo
+
+
+class VariantIndex:
+    """The bounded, thread-safe source-digest -> renditions table."""
+
+    def __init__(
+        self,
+        *,
+        max_sources: int = 512,
+        max_variants: int = 16,
+        ttl_s: float = 3600.0,
+        storage=None,
+        clock: Callable[[], float] = time.time,
+    ) -> None:
+        self.max_sources = max(1, int(max_sources))
+        self.max_variants = max(1, int(max_variants))
+        self.ttl_s = float(ttl_s)
+        self._storage = storage
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._io_lock = threading.Lock()  # serializes manifest writes
+        self._sources: "OrderedDict[str, _SourceState]" = OrderedDict()
+
+    @classmethod
+    def from_params(cls, params, *, storage=None):
+        return cls(
+            max_sources=int(params.by_key("reuse_index_max_sources", 512)),
+            max_variants=int(params.by_key("reuse_index_max_variants", 16)),
+            ttl_s=float(params.by_key("reuse_index_ttl_s", 3600.0)),
+            storage=storage,
+        )
+
+    # -- lookups -----------------------------------------------------------
+
+    def lookup(self, source_key: str) -> Optional[SourceEntry]:
+        """The source's entry, or None when nothing reuse-relevant is
+        known. A fresh in-memory state answers immediately; a stale or
+        absent one re-reads the storage manifest (outside the lock) so a
+        cold process converges on what storage holds."""
+        now = self._clock()
+        with self._lock:
+            state = self._sources.get(source_key)
+            if state is not None and self._fresh_locked(state, now):
+                self._sources.move_to_end(source_key)
+                return self._snapshot_locked(source_key, state)
+        doc = self._load_manifest(source_key)
+        now = self._clock()
+        with self._lock:
+            # a record() that landed while we read storage wins: it is
+            # strictly newer information than the manifest we just parsed
+            state = self._sources.get(source_key)
+            if state is not None and self._fresh_locked(state, now):
+                self._sources.move_to_end(source_key)
+                return self._snapshot_locked(source_key, state)
+            state = self._state_from_doc(doc, now)
+            self._sources[source_key] = state
+            self._sources.move_to_end(source_key)
+            self._bound_sources_locked()
+            return self._snapshot_locked(source_key, state)
+
+    def _fresh_locked(self, state: _SourceState, now: float) -> bool:
+        ttl = min(self.ttl_s, NEGATIVE_TTL_S) if state.negative else self.ttl_s
+        return now - state.loaded_at <= ttl
+
+    def _snapshot_locked(
+        self, source_key: str, state: _SourceState
+    ) -> Optional[SourceEntry]:
+        if state.negative or not state.variants:
+            return None
+        return SourceEntry(
+            source_key=source_key,
+            source_mime=state.source_mime,
+            variants=tuple(state.variants.values()),
+        )
+
+    # -- population --------------------------------------------------------
+
+    def record(
+        self, source_key: str, source_mime: str, facts: VariantFacts
+    ) -> None:
+        """Index one just-stored rendition (the handler calls this after
+        every cache write when reuse is enabled). Non-pure renditions are
+        dropped here — they can never serve as ancestors. Write-through
+        to the storage manifest happens outside the lock, best-effort."""
+        if not facts.pure:
+            return
+        now = self._clock()
+        with self._lock:
+            state = self._sources.get(source_key)
+            known = state is not None and not state.negative
+        seeded: Optional[_SourceState] = None
+        if not known:
+            # cold record (restart, LRU eviction, or an rf_1/background
+            # refresh that never ran lookup()): the persisted manifest
+            # may list renditions this process has never seen — rebuild
+            # the state from it BEFORE inserting, or the write-through
+            # below would wipe every previously persisted variant (and
+            # clobber a good mime the caller may not know)
+            seeded = self._state_from_doc(
+                self._load_manifest(source_key), now
+            )
+        with self._lock:
+            state = self._sources.get(source_key)
+            if state is None or state.negative:
+                if seeded is not None and not seeded.negative:
+                    state = seeded
+                else:
+                    state = _SourceState(
+                        source_mime=source_mime, loaded_at=now
+                    )
+                self._sources[source_key] = state
+            state.source_mime = source_mime or state.source_mime
+            state.loaded_at = now
+            state.negative = False
+            state.variants[facts.name] = facts
+            while len(state.variants) > self.max_variants:
+                # evict the smallest rendition: the mipmap chain keeps
+                # its top — big ancestors serve the most descendants
+                smallest = min(
+                    state.variants.values(), key=lambda v: v.area
+                )
+                del state.variants[smallest.name]
+            self._sources.move_to_end(source_key)
+            self._bound_sources_locked()
+        self._persist(source_key)
+
+    def discard(self, source_key: str, name: str) -> None:
+        """Drop one rendition (deleted, pruned, corrupt, or rf_1
+        refreshed) and rewrite the manifest to match."""
+        with self._lock:
+            state = self._sources.get(source_key)
+            if state is None or name not in state.variants:
+                return
+            del state.variants[name]
+        self._persist(source_key)
+
+    def _bound_sources_locked(self) -> None:
+        while len(self._sources) > self.max_sources:
+            self._sources.popitem(last=False)
+
+    def __len__(self) -> int:
+        """Indexed renditions across all sources — the
+        ``flyimg_variant_index_entries`` gauge (service/app.py)."""
+        with self._lock:
+            return sum(
+                len(state.variants)
+                for state in self._sources.values()
+                if not state.negative
+            )
+
+    # -- manifest persistence ---------------------------------------------
+
+    def _persist(self, source_key: str) -> None:
+        """Serialized write-through. The doc is snapshotted under the
+        table lock AT WRITE TIME, inside the IO lock, so the last write
+        always persists the newest state — two concurrent record()s can
+        otherwise land their storage writes out of order and resurrect
+        the smaller doc (which the TTL re-read would then also erase
+        from memory). Holding ``_io_lock`` across the storage write is
+        the point: it is never taken anywhere else, and the table lock
+        is never held while waiting on it."""
+        if self._storage is None:
+            return
+        with self._io_lock:
+            with self._lock:
+                state = self._sources.get(source_key)
+                doc = (
+                    self._doc_locked(state)
+                    if state is not None and not state.negative
+                    else None
+                )
+            if doc is None:
+                return
+            self._store_manifest(source_key, doc)
+
+    def _doc_locked(self, state: _SourceState) -> Optional[dict]:
+        if self._storage is None:
+            return None
+        return {
+            "v": MANIFEST_VERSION,
+            "source_mime": state.source_mime,
+            "variants": {
+                name: asdict(facts)
+                for name, facts in state.variants.items()
+            },
+        }
+
+    def _store_manifest(self, source_key: str, doc: Optional[dict]) -> None:
+        if doc is None or self._storage is None:
+            return
+        try:
+            self._storage.write(
+                manifest_name(source_key),
+                json.dumps(doc, sort_keys=True).encode("utf-8"),
+            )
+        except Exception as exc:
+            # persistence is an optimization for cold processes; a failed
+            # write must never fail the render that triggered it
+            logging.getLogger(LOGGER).warning(
+                "variant manifest write for %s failed: %s", source_key, exc
+            )
+
+    def _load_manifest(self, source_key: str) -> Optional[dict]:
+        if self._storage is None:
+            return None
+        try:
+            raw = self._storage.read(manifest_name(source_key))
+            doc = json.loads(raw.decode("utf-8"))
+        except Exception:
+            return None  # absent or corrupt: negative-cached by caller
+        if not isinstance(doc, dict) or doc.get("v") != MANIFEST_VERSION:
+            return None
+        return doc
+
+    def _state_from_doc(
+        self, doc: Optional[dict], now: float
+    ) -> _SourceState:
+        if doc is None:
+            return _SourceState(
+                source_mime="", loaded_at=now, negative=True
+            )
+        variants: Dict[str, VariantFacts] = {}
+        for name, row in (doc.get("variants") or {}).items():
+            try:
+                bg = row.get("background")
+                variants[name] = VariantFacts(
+                    name=str(name),
+                    out_w=int(row["out_w"]),
+                    out_h=int(row["out_h"]),
+                    extension=str(row["extension"]),
+                    quality=int(row["quality"]),
+                    lossy=bool(row["lossy"]),
+                    pure=bool(row["pure"]),
+                    colorspace=row.get("colorspace"),
+                    monochrome=bool(row.get("monochrome", False)),
+                    background=tuple(bg) if bg is not None else None,
+                    generations=int(row.get("generations", 0)),
+                    src_w=int(row["src_w"]),
+                    src_h=int(row["src_h"]),
+                    frame_key=str(row.get("frame_key", "")),
+                    stored_at=float(row.get("stored_at", 0.0)),
+                )
+            except (KeyError, TypeError, ValueError):
+                continue  # one malformed row must not poison the source
+        return _SourceState(
+            source_mime=str(doc.get("source_mime") or ""),
+            variants=variants,
+            loaded_at=now,
+            negative=False,
+        )

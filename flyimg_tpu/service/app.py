@@ -339,6 +339,14 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
         "Traces held in the tail-sampling ring buffer",
         fn=lambda: len(tracer),
     )
+    # derivative-reuse variant index occupancy (runtime/variantindex.py;
+    # docs/caching.md): reuse-safe renditions currently tracked — 0 and
+    # static whenever reuse_enable is off
+    metrics.gauge(
+        "flyimg_variant_index_entries",
+        "Reuse-safe renditions tracked by the per-source variant index",
+        fn=lambda: float(len(handler.variants)),
+    )
     # program-cache truth (ops/compose.py program_cache_entries): the
     # gauge behind the exact compile-hit accounting, replacing the old
     # miss-count inference (docs/observability.md)
@@ -580,6 +588,13 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
         headers = image_headers(
             result, params.by_key("header_cache_days", 365)
         )
+        if debug_enabled and result.reused_from:
+            # debug-only reuse attribution (docs/caching.md): which
+            # cached ancestor this render was re-derived from — the
+            # per-request signal tools/bench_http.py --mix multisize
+            # splits its latency rows on. Never emitted with debug off
+            # or reuse off, so production headers are unchanged.
+            headers["X-Flyimg-Reuse"] = result.reused_from
         if is_not_modified(request.headers, headers):
             return web.Response(
                 status=304,

@@ -26,10 +26,14 @@ import numpy as np
 from flyimg_tpu.appconfig import AppParameters
 from flyimg_tpu.codecs import decode, encode, media_info
 from flyimg_tpu.codecs.sniff import sniff
-from flyimg_tpu.exceptions import ServiceUnavailableException
+from flyimg_tpu.exceptions import (
+    DeadlineExceededException,
+    ServiceUnavailableException,
+)
 from flyimg_tpu.ops.compose import run_plan
 from flyimg_tpu.runtime import tracing
 from flyimg_tpu.runtime.resilience import Deadline
+from flyimg_tpu.runtime.variantindex import VariantFacts, VariantIndex
 from flyimg_tpu.service.input_source import FetchPolicy, load_source
 from flyimg_tpu.service.output_image import (
     EXT_TO_MIME,
@@ -43,9 +47,13 @@ from flyimg_tpu.spec.plan import (
     build_plan,
     decode_target_hint,
     degrade_plan,
+    lossy_output,
     parse_colorspace,
+    reuse_frame_key,
+    rewrite_for_reuse,
 )
 from flyimg_tpu.storage.base import Storage
+from flyimg_tpu.testing import faults
 
 
 class _SingleFlight:
@@ -73,8 +81,14 @@ class _SingleFlight:
             return True, fut
 
     def done(self, key: str, result=None, exc: Optional[BaseException] = None):
+        """Settle and clear the leader's future. Idempotent: a second
+        call for an already-settled key is a no-op — a leader error path
+        that double-calls done() must surface ITS exception, not a bare
+        KeyError from the pop (pinned by tests/test_reuse.py)."""
         with self._lock:
-            fut = self._inflight.pop(key)
+            fut = self._inflight.pop(key, None)
+        if fut is None:
+            return
         if exc is not None:
             fut.set_exception(exc)
         else:
@@ -102,6 +116,11 @@ class ProcessedImage:
     # headers — whenever the brownout engine is off or NORMAL.
     degraded: Tuple[str, ...] = ()
     stale: bool = False
+    # derivative reuse (docs/caching.md): the cached ancestor rendition
+    # this render was re-derived from, or None for a from-source render.
+    # Drives the debug-gated X-Flyimg-Reuse header; always None with
+    # reuse_enable off.
+    reused_from: Optional[str] = None
 
 
 class ImageHandler:
@@ -162,6 +181,23 @@ class ImageHandler:
         # stale-while-revalidate, plan rewriting, miss shedding. None or
         # disabled = today's behavior exactly (docs/degradation.md).
         self.brownout = brownout
+        # derivative-reuse rendering (docs/caching.md; ROADMAP item 2):
+        # the per-source variant index + the cache-aware rewriter knobs.
+        # Everything is inert with reuse_enable off — no lookups, no
+        # recording, no manifests, byte-identical serving (pinned by
+        # tests/test_reuse.py).
+        self.reuse_enable = bool(params.by_key("reuse_enable", False))
+        self.reuse_min_scale = float(params.by_key("reuse_min_scale", 2.0))
+        self.reuse_max_generations = int(
+            params.by_key("reuse_max_generations", 1)
+        )
+        # DEGRADED+ widening (the brownout compounding docs/degradation.md
+        # describes): under pressure a nearer ancestor and one extra lossy
+        # generation beat a full origin-fetch + decode + render
+        self.reuse_degraded_min_scale = float(
+            params.by_key("reuse_degraded_min_scale", 1.3)
+        )
+        self.variants = VariantIndex.from_params(params, storage=storage)
 
     # lazily import model backends so the service can run without them
     def _smartcrop(self):
@@ -218,29 +254,42 @@ class ImageHandler:
             separator=self.params.by_key("options_separator", ","),
         )
 
-        with tracing.span("fetch") as fetch_span:
-            source = load_source(
-                image_src,
-                options,
-                self.params.by_key("tmp_dir", "var/tmp"),
-                header_extra_options=self.params.by_key(
-                    "header_extra_options", ""
-                ),
-                policy=self.fetch_policy,
-                deadline=deadline,
-            )
-            if fetch_span is not None:
-                fetch_span.set_attribute("source.bytes", len(source.data))
-                fetch_span.set_attribute("source.mime", source.info.mime)
-        timings["fetch"] = time.perf_counter() - t0
-
-        spec = resolve_output(
-            options, image_src, source.info.mime, accepts_webp=accepts_webp
-        )
-
+        # derivative reuse (docs/caching.md): when the rewriter is on and
+        # the variant index already knows this source (mime + cached
+        # renditions), output naming, the cache check, and a reuse-safe
+        # render all proceed WITHOUT touching the origin — the fetch
+        # happens lazily, inside the leader, only when no safe ancestor
+        # exists. With reuse off this block is two cheap bool checks and
+        # the path below is exactly today's.
         refresh = options.wants_refresh()
+        source_key = (
+            OptionsBag.hash_original_image_url(image_src)
+            if self.reuse_enable else None
+        )
+        reuse_on = self.reuse_enable and not refresh
+        reuse_entry = None
+        source = None
+        spec = None
+        if reuse_on and source_key is not None:
+            reuse_entry = self.variants.lookup(source_key)
+            if reuse_entry is not None and reuse_entry.source_mime:
+                spec = resolve_output(
+                    options, image_src, reuse_entry.source_mime,
+                    accepts_webp=accepts_webp,
+                )
+        if spec is None:
+            source = self._load_source(image_src, options, timings, deadline)
+            spec = resolve_output(
+                options, image_src, source.info.mime,
+                accepts_webp=accepts_webp,
+            )
+
         if refresh:
             self.storage.delete(spec.name)  # idempotent when absent
+            if source_key is not None:
+                # the rebuilt output invalidates its index entry; the
+                # re-render below records fresh facts
+                self.variants.discard(source_key, spec.name)
 
         # ONE round trip answers cached? + bytes + stored-when? (separate
         # has/read/head calls would tax S3 serving's hot path 2-3x).
@@ -262,6 +311,8 @@ class ImageHandler:
                 self.storage.delete(spec.name)
             except Exception:
                 pass  # best effort; the re-render overwrites it anyway
+            if source_key is not None:
+                self.variants.discard(source_key, spec.name)
             cached = None
         if cached is not None:
             content, stat = cached
@@ -289,8 +340,19 @@ class ImageHandler:
                 if not engine.shed_active():
                     # at SHED even refreshes stop: the queue bound
                     # protects the device, but a shedding tier should
-                    # spend zero miss-pipeline work it can avoid
-                    self._schedule_refresh(spec, options, source.data)
+                    # spend zero miss-pipeline work it can avoid (on the
+                    # reuse fast path the source was never fetched — the
+                    # background refresh fetches it itself)
+                    self._schedule_refresh(
+                        spec, options,
+                        source.data if source is not None else None,
+                        image_src,
+                        source_mime=(
+                            source.info.mime if source is not None
+                            else reuse_entry.source_mime
+                            if reuse_entry is not None else ""
+                        ),
+                    )
             if self.metrics is not None:
                 self.metrics.record_cache(hit=True)
                 self.metrics.record_stage("cache_hit", time.perf_counter() - t0)
@@ -367,10 +429,39 @@ class ImageHandler:
                 if engine is not None and engine.plan_degrade_active()
                 else None
             )
-            content = self._process_new(
-                source.data, options, spec, timings, deadline=deadline,
-                degrade=degrade, degraded_out=modes,
-            )
+            # cache-aware reuse rewriting (docs/caching.md): re-derive
+            # from a cached ancestor rendition when one is reuse-safe —
+            # skipping the origin fetch and the full-size decode. Every
+            # unsafe combination falls through to the normal pipeline.
+            content = None
+            reused = None
+            reuse_generation = 0
+            render_info: Dict[str, object] = {}
+            if reuse_on and not spec.is_gif:
+                if reuse_entry is None:
+                    self._record_reuse("miss")
+                else:
+                    hit = self._try_reuse(
+                        reuse_entry, options, spec, timings,
+                        deadline=deadline, degrade=degrade,
+                        degraded_out=modes, render_info=render_info,
+                    )
+                    if hit is not None:
+                        content, reused, reuse_generation = hit
+            if content is None:
+                if source is None:
+                    # reuse fast path found no safe ancestor: pay the
+                    # origin fetch now (followers coalesced above never
+                    # fetch at all)
+                    source = self._load_source(
+                        image_src, options, timings, deadline
+                    )
+                render_info = {}
+                content = self._process_new(
+                    source.data, options, spec, timings, deadline=deadline,
+                    degrade=degrade, degraded_out=modes,
+                    render_info=render_info,
+                )
             if modes:
                 # degraded renders are served direct, never cached: the
                 # cache must only ever hold full-quality bytes, or a
@@ -388,6 +479,17 @@ class ImageHandler:
                 # just now
                 with tracing.span("storage", op="write", bytes=len(content)):
                     modified_at = self.storage.write(spec.name, content)
+                if source_key is not None:
+                    self._record_variant(
+                        source_key,
+                        (
+                            source.info.mime if source is not None
+                            else reuse_entry.source_mime
+                        ),
+                        spec, options, render_info,
+                        generations=reuse_generation,
+                        ancestor=reused,
+                    )
         except BaseException as exc:
             self._singleflight.done(spec.name, exc=exc)
             raise
@@ -395,6 +497,11 @@ class ImageHandler:
             spec.name, result=(content, modified_at, tuple(modes))
         )
         timings["total"] = time.perf_counter() - t0
+        if reused is not None:
+            # the reuse-hit serve gets its own stage series (and a
+            # perf-gate column, tools/perf_gate.py schema 4) so later
+            # PRs can't silently regress the reuse path
+            timings["reuse_hit"] = timings["total"]
         if self.metrics is not None:
             self.metrics.record_cache(hit=False)
             for stage, seconds in timings.items():
@@ -402,6 +509,7 @@ class ImageHandler:
         return ProcessedImage(
             content=content, spec=spec, options=options, timings=timings,
             modified_at=modified_at, degraded=tuple(modes),
+            reused_from=reused.name if reused is not None else None,
         )
 
     # ------------------------------------------------------------------
@@ -426,8 +534,38 @@ class ImageHandler:
             deadline=deadline,
         )
 
+    def _load_source(
+        self,
+        image_src: str,
+        options: OptionsBag,
+        timings: Dict[str, float],
+        deadline: Optional[Deadline],
+    ):
+        """The origin fetch + ingest step (service/input_source.py) with
+        its span + stage timing — ONE copy shared by the eager path, the
+        reuse fallback (lazy, inside the leader), and the background
+        stale refresh."""
+        t = time.perf_counter()
+        with tracing.span("fetch") as fetch_span:
+            source = load_source(
+                image_src,
+                options,
+                self.params.by_key("tmp_dir", "var/tmp"),
+                header_extra_options=self.params.by_key(
+                    "header_extra_options", ""
+                ),
+                policy=self.fetch_policy,
+                deadline=deadline,
+            )
+            if fetch_span is not None:
+                fetch_span.set_attribute("source.bytes", len(source.data))
+                fetch_span.set_attribute("source.mime", source.info.mime)
+        timings["fetch"] = time.perf_counter() - t
+        return source
+
     def _schedule_refresh(self, spec: OutputSpec, options: OptionsBag,
-                          data: bytes) -> None:
+                          data: Optional[bytes], image_src: str,
+                          source_mime: str = "") -> None:
         """Queue ONE background re-render of a stale cache entry
         (stale-while-revalidate, runtime/brownout.py). Coalescing is
         two-layer: the RefreshQueue dedups per derived key (N stale hits
@@ -435,7 +573,10 @@ class ImageHandler:
         single-flight table, so it also coalesces with any concurrent
         foreground miss for the same key. The refresh renders FULL
         quality whatever the current level — the cache must converge to
-        fresh, undegraded bytes — under the configured default deadline."""
+        fresh, undegraded bytes — under the configured default deadline.
+        ``data`` is None when the stale hit was served off the reuse
+        fast path (no source in hand); the refresh fetches it here, on
+        the background thread, not on the serving path."""
         engine = self.brownout
 
         def refresh() -> None:
@@ -443,13 +584,28 @@ class ImageHandler:
             if not leader:
                 return  # a foreground render is already computing it
             try:
+                deadline = Deadline(
+                    self.default_deadline_s, metrics=self.metrics
+                )
+                payload = data
+                mime = source_mime
+                if payload is None:
+                    fetched = self._load_source(
+                        image_src, options, {}, deadline
+                    )
+                    payload = fetched.data
+                    mime = fetched.info.mime
+                render_info: Dict[str, object] = {}
                 content = self._process_new(
-                    data, options, spec, {},
-                    deadline=Deadline(
-                        self.default_deadline_s, metrics=self.metrics
-                    ),
+                    payload, options, spec, {}, deadline=deadline,
+                    render_info=render_info,
                 )
                 modified_at = self.storage.write(spec.name, content)
+                if self.reuse_enable:
+                    self._record_variant(
+                        OptionsBag.hash_original_image_url(image_src),
+                        mime, spec, options, render_info,
+                    )
             except BaseException as exc:
                 self._singleflight.done(spec.name, exc=exc)
                 raise
@@ -458,6 +614,194 @@ class ImageHandler:
             )
 
         engine.refresh.submit(spec.name, refresh)
+
+    # ------------------------------------------------------------------
+    # derivative reuse (docs/caching.md; runtime/variantindex.py)
+
+    def _try_reuse(
+        self,
+        entry,
+        options: OptionsBag,
+        spec: OutputSpec,
+        timings: Dict[str, float],
+        *,
+        deadline: Optional[Deadline],
+        degrade,
+        degraded_out: Optional[List[str]],
+        render_info: Dict[str, object],
+    ):
+        """Attempt to render this miss from a cached ancestor rendition.
+        Candidates are tried largest-first; the first one that passes
+        the safety rules (spec.plan.rewrite_for_reuse) AND whose bytes
+        are still readable wins. Returns ``(content, ancestor_facts,
+        generations)`` or None after counting the outcome under
+        ``flyimg_reuse_hits_total{outcome=}``."""
+        min_scale = self.reuse_min_scale
+        max_generations = self.reuse_max_generations
+        widened = False
+        engine = self.brownout
+        if engine is not None and engine.swr_active():
+            # DEGRADED+ widening (docs/degradation.md "Reuse widening"):
+            # under pressure a nearer ancestor and one extra lossy
+            # generation beat a full origin-fetch + decode + render
+            widened = True
+            min_scale = self.reuse_degraded_min_scale
+            max_generations += 1
+        reason = None
+        for anc in entry.candidates():
+            if anc.name == spec.name:
+                continue
+            plan, target_out, why = rewrite_for_reuse(
+                options, spec.extension, anc,
+                min_scale=min_scale, max_generations=max_generations,
+            )
+            if plan is None:
+                reason = why
+                continue
+            blob = self._fetch_ancestor(entry.source_key, anc)
+            if blob is None:
+                reason = "ancestor_gone"
+                continue
+            try:
+                content = self._process_new(
+                    blob, options, spec, timings, deadline=deadline,
+                    degrade=degrade, degraded_out=degraded_out,
+                    render_info=render_info,
+                )
+            except DeadlineExceededException:
+                raise  # an exhausted budget is a 504 either way
+            except Exception:
+                # a torn write can leave a blob with valid leading magic
+                # but an undecodable body — the sniff in _fetch_ancestor
+                # cannot see that. Drop the rendition and fall back to
+                # the from-source pipeline instead of failing the
+                # request (and its coalesced followers).
+                self.variants.discard(entry.source_key, anc.name)
+                tracing.add_event(
+                    "reuse.ancestor_invalid", ancestor=anc.name
+                )
+                reason = "ancestor_gone"
+                continue
+            # hit accounting only AFTER the render succeeded — a failed
+            # attempt above must not read as a hit in metrics or spans
+            scale = min(
+                anc.out_w / max(target_out[0], 1),
+                anc.out_h / max(target_out[1], 1),
+            )
+            tracing.add_event(
+                "reuse.ancestor_hit", ancestor=anc.name,
+                scale=round(scale, 3), generations=anc.generations,
+                widened=widened,
+            )
+            self._record_reuse("hit")
+            generations = anc.generations + (1 if anc.lossy else 0)
+            if self.metrics is not None:
+                self.metrics.histogram(
+                    "flyimg_reuse_generations",
+                    "Lossy re-encode depth of reuse-rendered outputs",
+                    bounds=(0.5, 1.5, 2.5, 3.5),
+                ).observe(float(generations))
+            return content, anc, generations
+        self._record_reuse("unsafe" if reason is not None else "miss")
+        return None
+
+    def _fetch_ancestor(self, source_key: str, anc) -> Optional[bytes]:
+        """Read + validate one candidate ancestor's bytes. A missing or
+        corrupt rendition is dropped from the index (the index is a
+        cache of storage state, never the truth) and the caller tries
+        the next candidate. The ``reuse.ancestor`` fault point may
+        inject bytes (simulated ancestor) or raise (simulated pruned
+        object -> fall back to the full pipeline)."""
+        try:
+            injected = faults.fire("reuse.ancestor", name=anc.name)
+            if injected is not faults.PASS:
+                blob = injected
+            else:
+                fetched = self.storage.fetch(anc.name)
+                blob = fetched[0] if fetched is not None else None
+        except Exception:
+            blob = None
+        expected = EXT_TO_MIME.get(anc.extension)
+        if not blob or (
+            expected is not None and sniff(blob).mime != expected
+        ):
+            self.variants.discard(source_key, anc.name)
+            return None
+        return blob
+
+    def _record_reuse(self, outcome: str) -> None:
+        """One reuse-rewriter decision on a cache miss; ``outcome`` is
+        the fixed vocabulary hit | unsafe | miss (docs/observability.md)."""
+        if self.metrics is None:
+            return
+        self.metrics.counter(
+            f'flyimg_reuse_hits_total{{outcome="{outcome}"}}',
+            "Cache-miss reuse-rewriter decisions by outcome",
+        ).inc()
+
+    def _record_variant(
+        self,
+        source_key: str,
+        source_mime: str,
+        spec: OutputSpec,
+        options: OptionsBag,
+        render_info: Dict[str, object],
+        *,
+        generations: int = 0,
+        ancestor=None,
+    ) -> None:
+        """Index a just-stored rendition when it is a reuse-safe
+        ancestor (a pure full-frame resample). For reuse renders the
+        recorded source dims propagate from the chosen ancestor, so the
+        chain keeps describing the TRUE source scale."""
+        plan = render_info.get("plan")
+        src_size = (
+            (ancestor.src_w, ancestor.src_h)
+            if ancestor is not None
+            else render_info.get("src_size")
+        )
+        if plan is None or src_size is None or spec.is_gif:
+            return
+        if spec.extension not in ("png", "jpg", "webp"):
+            return
+        pure = (
+            plan.resize_to is not None
+            and plan.extent is None
+            and plan.extract is None
+            and plan.rotate is None
+            and plan.colorspace is None
+            and not plan.monochrome
+            and plan.unsharp is None
+            and plan.sharpen is None
+            and plan.blur is None
+            and not plan.smart_crop
+            and not plan.face_blur
+            and not plan.face_crop
+        )
+        if not pure:
+            return  # only reuse-safe ancestors are worth indexing
+        out_w, out_h = plan.resize_to
+        self.variants.record(
+            source_key,
+            source_mime,
+            VariantFacts(
+                name=spec.name,
+                out_w=out_w,
+                out_h=out_h,
+                extension=spec.extension,
+                quality=options.int_option("quality", 90) or 90,
+                lossy=lossy_output(spec.extension, options),
+                pure=True,
+                colorspace=None,
+                monochrome=False,
+                background=plan.background,
+                generations=generations,
+                src_w=int(src_size[0]),
+                src_h=int(src_size[1]),
+                frame_key=reuse_frame_key(options),
+                stored_at=time.time(),
+            ),
+        )
 
     # ------------------------------------------------------------------
     # deadline-aware device waits
@@ -777,9 +1121,14 @@ class ImageHandler:
         deadline: Optional[Deadline] = None,
         degrade=None,
         degraded_out: Optional[List[str]] = None,
+        render_info: Optional[Dict[str, object]] = None,
     ) -> bytes:
         """Transform pipeline on a cache miss (reference
         ImageHandler::processNewImage, ImageHandler.php:160-181).
+
+        ``render_info`` (when given) receives the resolved ``plan`` and
+        the decoded ``src_size`` — the facts the variant index records
+        about a stored rendition (docs/caching.md).
 
         ``degrade`` (the brownout engine, at BROWNOUT+) rewrites the plan
         to cheaper work — finishing ops dropped, host entropy crop in
@@ -825,6 +1174,9 @@ class ImageHandler:
 
         w, h = decoded.size
         plan = build_plan(options, w, h, metrics=self.metrics)
+        if render_info is not None:
+            render_info["plan"] = plan
+            render_info["src_size"] = (w, h)
         quality_cap = None
         if degrade is not None:
             plan, dropped = degrade_plan(plan)

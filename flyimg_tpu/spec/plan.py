@@ -21,7 +21,11 @@ from __future__ import annotations
 import math
 import re
 from dataclasses import dataclass, replace
-from typing import Optional, Tuple
+from typing import TYPE_CHECKING, Optional, Tuple
+
+if TYPE_CHECKING:  # annotation-only: spec must not import runtime at
+    # module scope (runtime.batcher imports this module — a cycle)
+    from flyimg_tpu.runtime.variantindex import VariantFacts
 
 from flyimg_tpu.exceptions import InvalidArgumentException
 from flyimg_tpu.spec.colors import parse_color
@@ -275,6 +279,148 @@ def degrade_plan(plan: TransformPlan) -> Tuple[TransformPlan, Tuple[str, ...]]:
         replace(plan, unsharp=None, sharpen=None),
         ("refine",),
     )
+
+
+# ---------------------------------------------------------------------------
+# derivative-reuse rewriting (docs/caching.md; runtime/variantindex.py)
+
+#: default reuse-safety floor: a cached ancestor must be at least this
+#: many times the target's resample box on BOTH axes, so the ancestor's
+#: own resample never becomes the quality-determining step (the same >=2x
+#: rule the JPEG DCT-prescale decode enforces, codecs._dct_scale_num)
+REUSE_MIN_SCALE = 2.0
+#: default bound on lossy re-encode depth: an ancestor that is itself a
+#: reuse render of a lossy parent is one "generation" deep; past the cap
+#: the compounding quantization error can no longer be pinned <= 2 u8
+REUSE_MAX_GENERATIONS = 1
+
+
+def reuse_frame_key(options: "OptionsBag") -> str:
+    """The sub-source discriminator for the variant index: two renditions
+    of one source digest are only pixels-of-the-same-image when they
+    rasterized the same PDF page (pg_/dnst_), extracted the same video
+    frame (tm_), and selected the same GIF frame (gf_). Plain images get
+    the shared default key. Values normalize through str() so the int
+    defaults and their URL string forms (gf_0 vs absent-gf, both frame
+    0) produce ONE key; the unset checks are identity/equality against
+    None/''/False specifically because int 0 == False would otherwise
+    erase a real frame index."""
+    parts = []
+    for key in ("page_number", "density", "time", "gif-frame"):
+        value = options.get_option(key)
+        unset = value is None or value is False or value == ""
+        parts.append("" if unset else str(value))
+    return "|".join(parts)
+
+
+def lossy_output(out_extension: str, options: "OptionsBag") -> bool:
+    """THE lossy-container predicate (jpg, or webp without webpl_1) —
+    one copy shared by the reuse rewriter's safety rules and the
+    handler's variant recording, so the stored ``VariantFacts.lossy``
+    and the rules consuming it can never drift when a new container
+    (avif, ...) lands."""
+    return out_extension == "jpg" or (
+        out_extension == "webp" and not options.truthy("webp-lossless")
+    )
+
+
+def rewrite_for_reuse(
+    options: "OptionsBag",
+    out_extension: str,
+    ancestor: "VariantFacts",
+    *,
+    min_scale: float = REUSE_MIN_SCALE,
+    max_generations: int = REUSE_MAX_GENERATIONS,
+) -> Tuple[Optional[TransformPlan], Optional[Tuple[int, int]], Optional[str]]:
+    """The cache-aware plan rewriter's safety core: given a request and
+    one cached ancestor's facts (runtime/variantindex.VariantFacts),
+    decide whether the request can be re-derived from the ancestor's
+    pixels, and build the plan that does it.
+
+    Returns ``(reuse_plan, target_resample_wh, None)`` when safe, or
+    ``(None, None, reason)`` naming the FIRST violated rule — every
+    reason is a pinned negative test (tests/test_reuse.py) and the
+    handler counts them under ``flyimg_reuse_hits_total{outcome=}``.
+
+    The rules (docs/caching.md "Reuse-safety rules"):
+
+    - ``impure``      the ancestor baked in more than a full-frame
+                      resample (extract/extent/rotate/value ops/post
+                      passes) — its pixels are not "the source, smaller"
+    - ``extract``     the target's e_ box is in SOURCE pixel coordinates;
+                      against the ancestor's frame the same numbers name
+                      a different (possibly out-of-frame) region
+    - ``face_ops``/``smart_crop``  content-dependent passes must score
+                      the real render, not a twice-resampled one
+    - ``metadata``    st_0 grafts SOURCE container metadata, which the
+                      ancestor no longer carries
+    - ``frame``       different PDF page / video time / GIF frame under
+                      one source digest
+    - ``colorspace``  the ancestor was narrowed (gray/monochrome baked
+                      in); the target needs the superset RGB samples
+    - ``generations`` lossy re-encode depth would exceed the cap
+    - ``lossless``    a lossless target (png, webp+webpl_1) must not
+                      inherit an ancestor's JPEG quantization
+    - ``quality``     a lossy ancestor below the target's q_ would leak
+                      its coarser quantization into a finer-q output
+    - ``background``  a bg_ mismatch would flatten alpha over the wrong
+                      color (the ancestor already composited)
+    - ``scale``       the ancestor is under ``min_scale``x the target's
+                      resample box on either axis (upscale-from-smaller
+                      is the degenerate case)
+    - ``geometry``    the plan rebuilt against the ancestor's dims does
+                      not resolve to the same program signature as the
+                      plan built against the true source dims (pns/par
+                      clamp edge cases) — the master correctness gate
+
+    The returned plan is ``build_plan(options, ancestor dims)``: the
+    ancestor IS the source at different dims, so the normal pipeline
+    (decode -> device program -> encode) renders it unchanged — reuse
+    renders take no special code path, only different input bytes.
+    """
+    if not ancestor.pure:
+        return None, None, "impure"
+    if options.truthy("extract"):
+        return None, None, "extract"
+    if options.truthy("face-blur") or options.truthy("face-crop"):
+        return None, None, "face_ops"
+    if options.truthy("smart-crop"):
+        return None, None, "smart_crop"
+    if not options.truthy("strip"):
+        return None, None, "metadata"
+    if reuse_frame_key(options) != ancestor.frame_key:
+        return None, None, "frame"
+    if ancestor.colorspace is not None or ancestor.monochrome:
+        return None, None, "colorspace"
+    if ancestor.generations >= max_generations:
+        return None, None, "generations"
+    lossy_out = lossy_output(out_extension, options)
+    if ancestor.lossy:
+        if not lossy_out:
+            return None, None, "lossless"
+        quality = options.int_option("quality", 90) or 90
+        if ancestor.quality < quality:
+            return None, None, "quality"
+    # metrics=None on BOTH plan builds: the real render's build_plan does
+    # the filter-alias counting; safety probes must not double-count
+    target_plan = build_plan(options, ancestor.src_w, ancestor.src_h)
+    if target_plan.background != ancestor.background:
+        return None, None, "background"
+    target_out = (
+        target_plan.resize_to
+        if target_plan.resize_to is not None
+        else target_plan.effective_src
+    )
+    tw, th = target_out
+    if (
+        ancestor.out_w < min_scale * tw
+        or ancestor.out_h < min_scale * th
+    ):
+        return None, None, "scale"
+    reuse_plan = build_plan(options, ancestor.out_w, ancestor.out_h)
+    if reuse_plan.signature() != target_plan.signature():
+        return None, None, "geometry"
+    return reuse_plan, target_out, None
 
 
 def rotated_bounds(w: int, h: int, degrees: float) -> Tuple[int, int]:

@@ -42,6 +42,12 @@ through:
                         slow-primary tail. Return value ignored
                         (latency-only point — use ``storage.read`` for
                         value injection)
+    ``reuse.ancestor``  one ancestor-rendition read by the derivative-
+                        reuse rewriter (service/handler.py _fetch_ancestor),
+                        ctx ``name``; a plan may return bytes (simulated
+                        ancestor) or raise (simulated pruned/corrupt
+                        ancestor — the handler must fall back to the
+                        full from-source pipeline, docs/caching.md)
 
 Production cost is one module-level ``None`` check per point (no injector
 installed -> ``fire`` returns ``PASS`` immediately). Tests install a
@@ -88,6 +94,7 @@ KNOWN_POINTS = frozenset({
     "batcher.drain",
     "brownout.signal",
     "brownout.refresh",
+    "reuse.ancestor",
 })
 
 #: sentinel: "no plan fired — run the real code path"

@@ -41,7 +41,7 @@ def _cost(flops=1.0e7, bytes_total=2.0e6):
 
 
 BASE = {"decode": 10.0, "device": 40.0, "encode": 12.0,
-        "total": 65.0, "cache_hit": 8.0}
+        "total": 65.0, "cache_hit": 8.0, "reuse_hit": 30.0}
 
 
 def test_compare_passes_identical_measurements():
@@ -184,7 +184,7 @@ def test_gate_cost_self_test_injected_flop_regression_fails(tmp_path):
     update = run("--update")
     assert update.returncode == 0, update.stderr
     doc = json.loads(baseline.read_text())
-    assert doc["schema"] == 3
+    assert doc["schema"] == 4
     assert set(doc["kernels"]) == {"dense", "banded"}
     for kern in ("dense", "banded"):
         cost = doc["kernels"][kern]["plan_cost"]

@@ -1,0 +1,692 @@
+"""Derivative-reuse rendering (docs/caching.md): the per-source variant
+index (runtime/variantindex.py), the cache-aware plan rewriter
+(spec.plan.rewrite_for_reuse), and their handler integration.
+
+Four pinned contracts:
+
+1. **Off is off**: with ``reuse_enable`` false (the default) the serving
+   path is byte-identical to the from-source pipeline, with no index
+   entries, no manifests in storage, and no reuse markers.
+2. **Parity**: a reuse-rendered output is within 2 u8 of the from-source
+   render across the resize/crop/quality matrix.
+3. **Safety**: every unsafe combination (upscale-from-smaller,
+   out-of-frame extract, face ops, smart crop, generation cap,
+   colorspace narrowing, quality inversion, lossless-from-lossy,
+   background mismatch, metadata preservation, gif output, pruned
+   ancestor) falls back to the full from-source pipeline.
+4. **No origin touch**: a reuse hit renders with the source file gone
+   and the L1 original cache emptied — the origin is provably never
+   consulted.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from flyimg_tpu.appconfig import AppParameters
+from flyimg_tpu.codecs import decode, encode
+from flyimg_tpu.runtime.metrics import MetricsRegistry
+from flyimg_tpu.runtime.variantindex import (
+    VariantFacts,
+    VariantIndex,
+    manifest_name,
+)
+from flyimg_tpu.service.handler import ImageHandler, _SingleFlight
+from flyimg_tpu.spec.options import OptionsBag
+from flyimg_tpu.spec.plan import reuse_frame_key, rewrite_for_reuse
+from flyimg_tpu.storage.local import LocalStorage
+from flyimg_tpu.testing import faults
+
+
+def _gradient(w=256, h=192):
+    """Smooth source: the <=2 u8 parity bound is a statement about the
+    twice-resampled pixels, and gradients are the honest (non-aliasing)
+    case real photos approximate."""
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+    return np.stack(
+        [
+            xx * (255.0 / max(w - 1, 1)),
+            yy * (255.0 / max(h - 1, 1)),
+            (xx + yy) * (255.0 / max(w + h - 2, 1)),
+        ],
+        axis=-1,
+    ).astype(np.uint8)
+
+
+def _make_env(tmp_path, sub, **over):
+    params = AppParameters({
+        "tmp_dir": str(tmp_path / sub / "tmp"),
+        "upload_dir": str(tmp_path / sub / "uploads"),
+        **over,
+    })
+    storage = LocalStorage(params)
+    metrics = MetricsRegistry()
+    handler = ImageHandler(storage, params, metrics=metrics)
+    return handler, storage, metrics
+
+
+@pytest.fixture()
+def env(tmp_path):
+    """(reuse-on handler, reuse-off handler, source path, tmp_path).
+    Both handlers see the SAME source file but separate stores, so every
+    assertion can compare reuse output against the untouched pipeline."""
+    src = tmp_path / "src.png"
+    src.write_bytes(encode(_gradient(), "png"))
+    on = _make_env(tmp_path, "on", reuse_enable=True)
+    off = _make_env(tmp_path, "off")
+    return on, off, str(src), tmp_path
+
+
+def _counter(metrics, name):
+    counter = metrics._counters.get(name)
+    return counter.value if counter is not None else 0.0
+
+
+def _reuse_count(metrics, outcome):
+    return _counter(
+        metrics, f'flyimg_reuse_hits_total{{outcome="{outcome}"}}'
+    )
+
+
+ANCESTOR = "w_128,o_png"  # pure full-frame resample: 256x192 -> 128x96
+
+
+def _seed(handler, src, options=ANCESTOR):
+    result = handler.process_image(options, src)
+    assert result.reused_from is None
+    return result
+
+
+# ---------------------------------------------------------------------------
+# 1. off is off
+
+
+def test_reuse_off_is_byte_identical_and_inert(env):
+    (on, _, _), (off, off_storage, off_metrics), src, tmp_path = env
+    # seed the reuse handler so its render COULD go through the rewriter
+    _seed(on, src)
+    for options in (ANCESTOR, "w_48,h_36,c_1,o_png", "w_40,o_jpg,q_85"):
+        got = on.process_image(options, src)
+        want = off.process_image(options, src)
+        if got.reused_from is None:
+            # identical pipelines -> identical bytes
+            assert got.content == want.content
+        assert want.reused_from is None
+    # the off handler never indexed, persisted, or counted anything
+    assert len(off.variants) == 0
+    uploads = os.listdir(str(tmp_path / "off" / "uploads"))
+    assert not [n for n in uploads if "variants" in n]
+    assert _reuse_count(off_metrics, "hit") == 0.0
+    assert _reuse_count(off_metrics, "miss") == 0.0
+
+
+def test_reuse_off_records_no_manifest_but_on_does(env):
+    (on, on_storage, _), _, src, tmp_path = env
+    _seed(on, src)
+    key = OptionsBag.hash_original_image_url(src)
+    raw = on_storage.read(manifest_name(key))
+    doc = json.loads(raw.decode("utf-8"))
+    assert doc["source_mime"] == "image/png"
+    assert len(doc["variants"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# 2. parity sweep
+
+
+PARITY_MATRIX = (
+    "w_48,h_36,c_1,o_png",          # crop-fill
+    "w_40,o_png",                   # plain fit resize
+    "w_60,h_40,c_1,g_North,o_png",  # crop with gravity
+    "w_32,h_32,c_1,o_png",          # square crop
+    "w_50,q_90,o_png",              # the q_90 geometry, lossless view
+    "w_44,h_33,c_1,q_85,o_png",     # the q_85 crop geometry, lossless
+    "w_40,clsp_gray,o_png",         # colorspace applied AFTER reuse
+    "r_90,w_40,o_png",              # rotate commutes with the resample
+)
+
+# lossy legs: the SAME geometries served as JPEG. The <=2 u8 parity
+# statement is about the rendered pixels; a JPEG container then
+# quantizes both sides independently, and two encoders fed inputs <=2 u8
+# apart legally decode several units apart at block edges — so the
+# lossless twin above carries the strict pixel bound while the decoded
+# JPEG view gets a quantization-amplification allowance.
+LOSSY_MATRIX = (
+    "w_50,o_jpg,q_90",
+    "w_44,h_33,c_1,o_jpg,q_85",
+)
+JPEG_AMPLIFICATION_U8 = 8
+
+
+def test_reuse_parity_within_2u8_across_matrix(env):
+    (on, _, on_metrics), (off, _, _), src, _ = env
+    _seed(on, src)
+    for options in PARITY_MATRIX:
+        got = on.process_image(options, src)
+        assert got.reused_from is not None, f"{options} did not reuse"
+        assert got.from_cache is False
+        want = off.process_image(options, src)
+        a = decode(got.content).rgb.astype(int)
+        b = decode(want.content).rgb.astype(int)
+        assert a.shape == b.shape, options
+        diff = int(np.abs(a - b).max())
+        assert diff <= 2, f"{options}: max diff {diff} u8"
+    assert _reuse_count(on_metrics, "hit") == len(PARITY_MATRIX)
+
+
+def test_reuse_parity_lossy_outputs(env):
+    """JPEG legs of the matrix: the request reuse-hits, the decoded
+    container view stays within the quantization-amplification bound,
+    and the pixel-domain parity itself is pinned by the lossless twins
+    in PARITY_MATRIX (same geometry + quality key, o_png)."""
+    (on, _, _), (off, _, _), src, _ = env
+    _seed(on, src)
+    for options in LOSSY_MATRIX:
+        got = on.process_image(options, src)
+        assert got.reused_from is not None, f"{options} did not reuse"
+        want = off.process_image(options, src)
+        a = decode(got.content).rgb.astype(int)
+        b = decode(want.content).rgb.astype(int)
+        assert a.shape == b.shape, options
+        diff = int(np.abs(a - b).max())
+        assert diff <= JPEG_AMPLIFICATION_U8, (
+            f"{options}: decoded-JPEG max diff {diff} u8"
+        )
+
+
+def test_reuse_hit_timing_and_stage_recorded(env):
+    (on, _, on_metrics), _, src, _ = env
+    _seed(on, src)
+    got = on.process_image("w_48,h_36,c_1,o_png", src)
+    assert got.reused_from is not None
+    assert got.timings["reuse_hit"] == got.timings["total"]
+    hist = on_metrics._histograms.get(
+        'flyimg_stage_seconds{stage="reuse_hit"}'
+    )
+    assert hist is not None
+
+
+def test_reuse_serves_with_origin_and_l1_cache_gone(env):
+    """THE no-origin-fetch proof: after seeding, delete the source file
+    AND the L1 original cache — a reuse-safe request still serves (the
+    normal pipeline would raise ReadFileException)."""
+    (on, _, _), _, src, tmp_path = env
+    _seed(on, src)
+    os.remove(src)
+    l1 = tmp_path / "on" / "tmp"
+    for name in os.listdir(str(l1)):
+        os.remove(str(l1 / name))
+    got = on.process_image("w_48,h_36,c_1,o_png", src)
+    assert got.reused_from is not None
+    assert len(got.content) > 0
+    # and an UNSAFE request now fails where it would have fetched
+    from flyimg_tpu.exceptions import ReadFileException
+
+    with pytest.raises(ReadFileException):
+        on.process_image("w_200,o_png", src)  # needs the origin
+
+
+def test_reuse_result_is_cached_and_served_as_hit_after(env):
+    (on, _, _), _, src, _ = env
+    _seed(on, src)
+    first = on.process_image("w_48,h_36,c_1,o_png", src)
+    assert first.reused_from is not None
+    second = on.process_image("w_48,h_36,c_1,o_png", src)
+    assert second.from_cache is True
+    assert second.content == first.content
+
+
+def test_reuse_chain_propagates_generations_and_true_source_dims(env):
+    (on, _, _), _, src, _ = env
+    _seed(on, src, "w_128,o_jpg,q_95")  # lossy pure ancestor (gen 0)
+    child = on.process_image("w_60,o_jpg,q_90", src)  # pure AND reused
+    assert child.reused_from is not None
+    key = OptionsBag.hash_original_image_url(src)
+    entry = on.variants.lookup(key)
+    facts = {v.name: v for v in entry.variants}
+    child_facts = facts[child.spec.name]
+    assert child_facts.generations == 1  # one lossy re-encode deep
+    assert (child_facts.src_w, child_facts.src_h) == (256, 192)
+
+
+# ---------------------------------------------------------------------------
+# 3. safety negatives — every unsafe combination takes the full pipeline
+
+
+def _expect_fallback(on, metrics, src, options, outcome="unsafe"):
+    before = _reuse_count(metrics, outcome)
+    got = on.process_image(options, src)
+    assert got.reused_from is None, options
+    assert _reuse_count(metrics, outcome) == before + 1
+    return got
+
+
+def test_unsafe_upscale_from_smaller(env):
+    (on, _, m), _, src, _ = env
+    _seed(on, src)  # ancestor 128x96
+    # target resample 100x75: ancestor < 2x on both axes
+    _expect_fallback(on, m, src, "w_100,o_png")
+
+
+def test_unsafe_out_of_frame_extract(env):
+    (on, _, m), _, src, _ = env
+    _seed(on, src)
+    # e_ coordinates are SOURCE-pixel coordinates; 200 > the ancestor's
+    # 128px frame — reuse must bypass, the full pipeline must serve
+    got = _expect_fallback(
+        on, m, src, "e_1,p1x_100,p1y_50,p2x_200,p2y_150,w_40,o_png"
+    )
+    assert len(got.content) > 0
+
+
+def test_unsafe_face_ops_and_smart_crop(env):
+    (on, _, m), _, src, _ = env
+    _seed(on, src)
+    _expect_fallback(on, m, src, "w_40,fb_1,o_png")
+    _expect_fallback(on, m, src, "w_40,h_40,smc_1,o_png")
+
+
+def test_unsafe_generation_cap(env):
+    (on, _, m), _, src, _ = env
+    _seed(on, src, "w_128,o_jpg,q_95")
+    child = on.process_image("w_60,o_jpg,q_90", src)
+    assert child.reused_from is not None  # gen-1 pure rendition indexed
+    key = OptionsBag.hash_original_image_url(src)
+    # drop the gen-0 ancestor: only the gen-1 child remains as candidate
+    entry = on.variants.lookup(key)
+    for v in entry.variants:
+        if v.generations == 0:
+            on.variants.discard(key, v.name)
+    _expect_fallback(on, m, src, "w_24,o_jpg,q_80")
+
+
+def test_unsafe_colorspace_narrowed_ancestor_not_indexed(env):
+    (on, _, m), _, src, _ = env
+    gray = on.process_image("w_128,clsp_gray,o_png", src)
+    assert gray.reused_from is None
+    assert len(on.variants) == 0  # narrowed rendition never indexed
+    _expect_fallback(on, m, src, "w_40,o_png", outcome="miss")
+
+
+def test_unsafe_quality_inversion(env):
+    (on, _, m), _, src, _ = env
+    _seed(on, src, "w_128,o_jpg,q_70")
+    _expect_fallback(on, m, src, "w_40,o_jpg,q_90")
+
+
+def test_unsafe_lossless_from_lossy(env):
+    (on, _, m), _, src, _ = env
+    _seed(on, src, "w_128,o_jpg,q_95")
+    _expect_fallback(on, m, src, "w_40,o_png")
+
+
+def test_unsafe_background_mismatch(env):
+    (on, _, m), _, src, _ = env
+    _seed(on, src)  # background None
+    _expect_fallback(on, m, src, "w_40,bg_red,o_png")
+
+
+def test_unsafe_metadata_preservation(env):
+    (on, _, m), _, src, _ = env
+    _seed(on, src)
+    _expect_fallback(on, m, src, "w_40,st_0,o_png")
+
+
+def test_unsafe_gif_output_never_reuses(env):
+    (on, _, m), _, src, _ = env
+    _seed(on, src)
+    before_hit = _reuse_count(m, "hit")
+    got = on.process_image("w_40,o_gif", src)
+    assert got.reused_from is None
+    assert _reuse_count(m, "hit") == before_hit
+
+
+def test_pruned_ancestor_falls_back_and_is_dropped(env):
+    (on, storage, m), _, src, _ = env
+    seeded = _seed(on, src)
+    storage.delete(seeded.spec.name)  # prune the bytes, keep the index
+    before = len(on.variants)
+    _expect_fallback(on, m, src, "w_48,h_36,c_1,o_png")
+    assert len(on.variants) < before  # validated-at-read drop
+
+
+def test_torn_ancestor_body_falls_back_and_is_dropped(env):
+    """A torn write can leave valid leading magic over an undecodable
+    body — the sniff in _fetch_ancestor passes, the decode inside the
+    reuse render fails. The request must fall back to the from-source
+    pipeline (not 5xx), the rendition must leave the index, and the
+    failed attempt must never read as a hit."""
+    (on, storage, m), _, src, _ = env
+    seeded = _seed(on, src)
+    storage.write(
+        seeded.spec.name, b"\x89PNG\r\n\x1a\n" + b"\xde\xad" * 64
+    )
+    before = len(on.variants)
+    before_hits = _reuse_count(m, "hit")
+    got = _expect_fallback(on, m, src, "w_48,h_36,c_1,o_png")
+    assert len(got.content) > 0
+    assert decode(got.content).rgb.shape[:2] == (36, 48)
+    assert _reuse_count(m, "hit") == before_hits
+    assert len(on.variants) < before  # validated-at-render drop
+
+
+def test_reuse_ancestor_fault_point_fallback(env):
+    (on, _, m), _, src, _ = env
+    _seed(on, src)
+    injector = faults.FaultInjector()
+    injector.plan(
+        "reuse.ancestor",
+        lambda **_: (_ for _ in ()).throw(OSError("pruned")),
+    )
+    faults.install(injector)
+    try:
+        got = on.process_image("w_48,h_36,c_1,o_png", src)
+    finally:
+        faults.clear()
+    assert got.reused_from is None
+    assert injector.fired.get("reuse.ancestor", 0) == 1
+    assert len(got.content) > 0
+
+
+def test_refresh_bypasses_reuse_and_reindexes(env):
+    (on, _, m), _, src, _ = env
+    seeded = _seed(on, src)
+    before_hits = _reuse_count(m, "hit")
+    refreshed = on.process_image(ANCESTOR + ",rf_1", src)
+    assert refreshed.reused_from is None
+    assert _reuse_count(m, "hit") == before_hits
+    assert len(on.variants) == 1  # re-render re-recorded fresh facts
+    assert refreshed.spec.name == seeded.spec.name
+
+
+# ---------------------------------------------------------------------------
+# brownout widening (DEGRADED+ accepts nearer ancestors)
+
+
+def test_brownout_widens_reuse_tolerance(tmp_path):
+    from flyimg_tpu.runtime.brownout import DEGRADED, BrownoutEngine
+
+    src = tmp_path / "src.png"
+    src.write_bytes(encode(_gradient(), "png"))
+    engine = BrownoutEngine(enabled=True, min_dwell_s=0.0)
+    params = AppParameters({
+        "tmp_dir": str(tmp_path / "t"),
+        "upload_dir": str(tmp_path / "u"),
+        "reuse_enable": True,
+    })
+    metrics = MetricsRegistry()
+    handler = ImageHandler(
+        LocalStorage(params), params, metrics=metrics, brownout=engine
+    )
+    handler.process_image("w_96,o_png", str(src))  # ancestor 96x72
+    # target resample 60x45: 96 < 2*60 -> unsafe at NORMAL...
+    normal = handler.process_image("w_60,h_45,c_1,o_png", str(src))
+    assert normal.reused_from is None
+    # ...but within the DEGRADED widened floor (1.3x: 78x58.5 <= 96x72)
+    injector = faults.FaultInjector()
+    injector.plan("brownout.signal", lambda **_: 0.7)
+    faults.install(injector)
+    try:
+        assert engine.evaluate() == DEGRADED
+        widened = handler.process_image("w_60,h_45,c_1,q_80,o_png", str(src))
+    finally:
+        faults.clear()
+    assert widened.reused_from is not None
+
+
+# ---------------------------------------------------------------------------
+# rewriter unit surface
+
+
+def _facts(**over):
+    base = dict(
+        name="anc.png", out_w=128, out_h=96, extension="png", quality=90,
+        lossy=False, pure=True, colorspace=None, monochrome=False,
+        background=None, generations=0, src_w=256, src_h=192,
+        frame_key=reuse_frame_key(OptionsBag("")), stored_at=0.0,
+    )
+    base.update(over)
+    return VariantFacts(**base)
+
+
+def _options(s):
+    return OptionsBag(s)
+
+
+def test_rewrite_reasons_unit():
+    options = _options("w_40,o_png")
+    plan, out, why = rewrite_for_reuse(options, "png", _facts())
+    assert why is None and plan is not None and out == (40, 30)
+    cases = (
+        (_facts(pure=False), "w_40,o_png", "png", "impure"),
+        (_facts(), "w_40,e_1,p1x_0,p1y_0,p2x_50,p2y_50,o_png", "png",
+         "extract"),
+        (_facts(), "w_40,fc_1,o_png", "png", "face_ops"),
+        (_facts(), "w_40,smc_1,o_png", "png", "smart_crop"),
+        (_facts(), "w_40,st_0,o_png", "png", "metadata"),
+        (_facts(frame_key="2||00:00:01|0"), "w_40,o_png", "png", "frame"),
+        (_facts(colorspace="gray"), "w_40,o_png", "png", "colorspace"),
+        (_facts(generations=1), "w_40,o_png", "png", "generations"),
+        (_facts(lossy=True, extension="jpg"), "w_40,o_png", "png",
+         "lossless"),
+        (_facts(lossy=True, extension="jpg", quality=70), "w_40,q_90,o_jpg",
+         "jpg", "quality"),
+        (_facts(), "w_40,bg_red,o_png", "png", "background"),
+        (_facts(), "w_100,o_png", "png", "scale"),
+    )
+    for facts, opts, ext, expected in cases:
+        plan, out, why = rewrite_for_reuse(_options(opts), ext, facts)
+        assert plan is None and why == expected, (opts, why)
+
+
+def test_frame_key_int_zero_matches_url_form():
+    """int 0 == False in Python: the unset check in reuse_frame_key must
+    not swallow the gif-frame default (int 0) while keeping its URL form
+    ("gf_0", str "0") — both spellings of frame 0 are ONE key, and a
+    real non-default frame still discriminates."""
+    default = reuse_frame_key(OptionsBag(""))
+    assert reuse_frame_key(OptionsBag("gf_0")) == default
+    assert reuse_frame_key(OptionsBag("pg_1")) == default
+    assert reuse_frame_key(OptionsBag("gf_2")) != default
+
+
+def test_rewrite_widened_scale_and_generations():
+    facts = _facts(lossy=True, extension="jpg", quality=95, generations=1)
+    options = _options("w_60,o_jpg,q_90")
+    plan, _, why = rewrite_for_reuse(options, "jpg", facts)
+    assert why == "generations"
+    plan, _, why = rewrite_for_reuse(
+        options, "jpg", facts, min_scale=1.3, max_generations=2
+    )
+    assert why is None and plan is not None
+
+
+# ---------------------------------------------------------------------------
+# variant index units
+
+
+class _Clock:
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self):
+        return self.now
+
+
+def test_index_ttl_rereads_manifest(tmp_path):
+    params = AppParameters({"upload_dir": str(tmp_path / "u")})
+    storage = LocalStorage(params)
+    clock = _Clock()
+    index = VariantIndex(ttl_s=10.0, storage=storage, clock=clock)
+    index.record("original-x", "image/png", _facts())
+    assert index.lookup("original-x") is not None
+    # delete the manifest behind the index's back; within TTL the memory
+    # copy answers, past it the (gone) manifest wins
+    storage.delete(manifest_name("original-x"))
+    assert index.lookup("original-x") is not None
+    clock.now += 11.0
+    assert index.lookup("original-x") is None
+
+
+def test_index_bounds_sources_lru_and_variants_by_area(tmp_path):
+    index = VariantIndex(max_sources=2, max_variants=2, storage=None)
+    for i in range(3):
+        index.record(f"original-{i}", "image/png", _facts(name=f"v{i}.png"))
+    assert index.lookup("original-0") is None  # LRU-evicted
+    assert index.lookup("original-2") is not None
+    index.record("original-2", "image/png",
+                 _facts(name="big.png", out_w=512, out_h=384))
+    index.record("original-2", "image/png",
+                 _facts(name="mid.png", out_w=256, out_h=192))
+    entry = index.lookup("original-2")
+    names = {v.name for v in entry.variants}
+    assert names == {"big.png", "mid.png"}  # smallest (v2) evicted
+    assert entry.candidates()[0].name == "big.png"  # largest first
+
+
+def test_index_cold_process_rebuilds_from_manifest(tmp_path):
+    params = AppParameters({"upload_dir": str(tmp_path / "u")})
+    storage = LocalStorage(params)
+    warm = VariantIndex(storage=storage)
+    warm.record("original-x", "image/jpeg", _facts())
+    cold = VariantIndex(storage=storage)
+    entry = cold.lookup("original-x")
+    assert entry is not None
+    assert entry.source_mime == "image/jpeg"
+    assert entry.candidates()[0].name == "anc.png"
+    # corrupt manifest -> negative entry, not an error
+    storage.write(manifest_name("original-y"), b"not json{")
+    assert cold.lookup("original-y") is None
+
+
+def test_index_discard_rewrites_manifest(tmp_path):
+    params = AppParameters({"upload_dir": str(tmp_path / "u")})
+    storage = LocalStorage(params)
+    index = VariantIndex(storage=storage)
+    index.record("original-x", "image/png", _facts())
+    index.discard("original-x", "anc.png")
+    assert index.lookup("original-x") is None
+    assert VariantIndex(storage=storage).lookup("original-x") is None
+
+
+def test_index_cold_record_preserves_persisted_variants(tmp_path):
+    """A record() with no in-memory state (restart / LRU eviction /
+    rf_1 without a prior lookup) must seed from the persisted manifest
+    before inserting — the write-through otherwise rewrites the
+    manifest to contain ONLY the new rendition, silently wiping every
+    previously persisted reuse candidate for that source."""
+    params = AppParameters({"upload_dir": str(tmp_path / "u")})
+    storage = LocalStorage(params)
+    warm = VariantIndex(storage=storage)
+    for i, name in enumerate(("a.png", "b.png", "c.png")):
+        warm.record(
+            "original-x", "image/png",
+            _facts(name=name, out_w=128 + 16 * i, out_h=96 + 12 * i),
+        )
+    cold = VariantIndex(storage=storage)  # fresh process, NO lookup()
+    cold.record("original-x", "", _facts(name="d.png", out_w=200, out_h=150))
+    doc = json.loads(storage.read(manifest_name("original-x")))
+    assert set(doc["variants"]) == {"a.png", "b.png", "c.png", "d.png"}
+    assert doc["source_mime"] == "image/png"  # recovered, not clobbered
+    entry = cold.lookup("original-x")
+    assert {v.name for v in entry.variants} == {
+        "a.png", "b.png", "c.png", "d.png"
+    }
+
+
+def test_index_concurrent_records_persist_newest_doc(tmp_path):
+    """Manifest write-through is serialized with an at-write-time
+    snapshot: a slow early writer must not land its (smaller) doc after
+    a later one and resurrect it — the LAST storage write always
+    carries the NEWEST variant set."""
+    params = AppParameters({"upload_dir": str(tmp_path / "u")})
+    storage = LocalStorage(params)
+    first_write_entered = threading.Event()
+    release_first_write = threading.Event()
+    written = []
+    real_write = storage.write
+
+    def slow_write(name, payload):
+        if name.endswith(".variants.json"):
+            written.append(json.loads(payload))
+            if len(written) == 1:
+                first_write_entered.set()
+                assert release_first_write.wait(timeout=5)
+        return real_write(name, payload)
+
+    storage.write = slow_write
+    index = VariantIndex(storage=storage)
+    t1 = threading.Thread(
+        target=index.record,
+        args=("original-x", "image/png", _facts(name="a.png")),
+    )
+    t1.start()
+    assert first_write_entered.wait(timeout=5)
+    # second record lands while the first writer is stalled mid-write
+    t2 = threading.Thread(
+        target=index.record,
+        args=(
+            "original-x", "image/png",
+            _facts(name="b.png", out_w=64, out_h=48),
+        ),
+    )
+    t2.start()
+    # t2 is queued behind the IO lock; releasing t1 lets both complete
+    release_first_write.set()
+    t1.join(timeout=5)
+    t2.join(timeout=5)
+    assert not t1.is_alive() and not t2.is_alive()
+    assert set(written[-1]["variants"]) == {"a.png", "b.png"}
+    doc = json.loads(storage.read(manifest_name("original-x")))
+    assert set(doc["variants"]) == {"a.png", "b.png"}
+
+
+def test_index_len_counts_variants(tmp_path):
+    index = VariantIndex(storage=None)
+    assert len(index) == 0
+    index.record("original-a", "image/png", _facts(name="a.png"))
+    index.record("original-b", "image/png", _facts(name="b.png"))
+    index.record("original-b", "image/png",
+                 _facts(name="c.png", out_w=64, out_h=48))
+    assert len(index) == 3
+    index.record("original-c", "image/png", _facts(pure=False))
+    assert len(index) == 3  # non-pure renditions are never indexed
+
+
+# ---------------------------------------------------------------------------
+# _SingleFlight.done idempotence (satellite regression)
+
+
+def test_singleflight_done_is_idempotent():
+    flight = _SingleFlight()
+    leader, fut = flight.begin("k")
+    assert leader
+    flight.done("k", result=(b"x", None, ()))
+    # a leader error path double-calling done must be a no-op, not a
+    # KeyError masking the original exception
+    flight.done("k", exc=RuntimeError("late duplicate"))
+    assert fut.result(timeout=1) == (b"x", None, ())
+    flight.done("never-begun")  # missing key: also a no-op
+
+
+def test_singleflight_double_done_under_concurrency():
+    flight = _SingleFlight()
+    _, fut = flight.begin("k")
+    errors = []
+
+    def settle():
+        try:
+            flight.done("k", result=("ok",))
+        except Exception as exc:  # pragma: no cover - the regression
+            errors.append(exc)
+
+    threads = [threading.Thread(target=settle) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert fut.result(timeout=1) == ("ok",)

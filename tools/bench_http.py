@@ -156,8 +156,67 @@ async def _miss_run(
 # apart; None (no --kernel) omits the field
 _KERNEL_TAG = None
 
+# derivative-reuse tag (--reuse): stamped into every result row exactly
+# like _KERNEL_TAG, so multisize A/B artifacts carry which rewriter
+# setting produced each curve; None (no --reuse) omits the field
+_REUSE_TAG = None
 
-def _report(name: str, mode: str, lat, failures: int, elapsed: float):
+
+def _zipf_weights(n: int, s: float = 1.1) -> list:
+    """Zipf-ish popularity over ladder ranks: rank r gets 1/(r+1)^s.
+    Real multi-size traffic concentrates on a few small renditions with
+    a long tail of odd sizes — exactly the distribution the variant
+    index is built for."""
+    raw = [1.0 / ((rank + 1) ** s) for rank in range(n)]
+    total = sum(raw)
+    return [w / total for w in raw]
+
+
+async def _multisize_run(
+    client: httpx.AsyncClient, urls: list, conc: int
+):
+    """Closed-loop run over distinct-key multisize URLs; every request
+    records (latency, reused) where ``reused`` comes from the
+    debug-gated X-Flyimg-Reuse header (docs/caching.md) — the split the
+    hit/miss rows are built from."""
+    samples: list = []
+    failures = 0
+    it = iter(urls)
+
+    async def worker():
+        nonlocal failures
+        while True:
+            url = next(it, None)
+            if url is None:
+                return
+            t0 = time.perf_counter()
+            try:
+                resp = await client.get(url)
+                ok = resp.status_code == 200 and len(resp.content) > 0
+            except httpx.HTTPError:
+                ok = False
+                resp = None
+            if ok:
+                samples.append(
+                    (
+                        time.perf_counter() - t0,
+                        "X-Flyimg-Reuse" in resp.headers,
+                    )
+                )
+            else:
+                failures += 1
+
+    start = time.perf_counter()
+    await asyncio.gather(*[worker() for _ in range(conc)])
+    elapsed = time.perf_counter() - start
+    return samples, failures, elapsed
+
+
+def _report(name: str, mode: str, lat, failures: int, elapsed: float,
+            extra: dict | None = None):
+    """``extra`` fields merge into the row BEFORE it is printed, so the
+    JSON line an artifact consumer scrapes carries them (the multisize
+    rows stamp reuse=hit|miss + ancestor_hit_ratio this way)."""
     if not lat:
         # all-failed legs are the MOST important rows of an overload
         # sweep (they mark the saturation knee): emit the same schema as
@@ -179,6 +238,10 @@ def _report(name: str, mode: str, lat, failures: int, elapsed: float):
         }
         if _KERNEL_TAG is not None:
             row["kernel"] = _KERNEL_TAG
+        if _REUSE_TAG is not None:
+            row["reuse_enable"] = _REUSE_TAG == "on"
+        if extra:
+            row.update(extra)
         print(f"{name:8s} {mode:6s}  ALL {failures} REQUESTS FAILED "
               "(saturated)")
         print(json.dumps(row))
@@ -201,12 +264,21 @@ def _report(name: str, mode: str, lat, failures: int, elapsed: float):
     }
     if _KERNEL_TAG is not None:
         row["kernel"] = _KERNEL_TAG
+    if _REUSE_TAG is not None:
+        row["reuse_enable"] = _REUSE_TAG == "on"
+    if extra:
+        row.update(extra)
+    # extra may null throughput/success (the multisize split legs share
+    # one wall clock, so per-leg rates cannot be measured honestly)
+    tp = row["throughput_rps"]
+    ok_rate = row["success_rate"]
     print(
-        f"{name:8s} {mode:6s}  {row['throughput_rps']:8.1f} req/s   "
-        f"mean {row['latency_ms']['mean']:7.2f}  p50 {row['latency_ms']['p50']:7.2f}  "
+        f"{name:8s} {mode:6s}  "
+        + (f"{tp:8.1f} req/s   " if tp is not None else "     n/a req/s   ")
+        + f"mean {row['latency_ms']['mean']:7.2f}  p50 {row['latency_ms']['p50']:7.2f}  "
         f"p95 {row['latency_ms']['p95']:7.2f}  p99 {row['latency_ms']['p99']:7.2f}  "
         f"max {row['latency_ms']['max']:8.2f} ms   "
-        f"ok {row['success_rate'] * 100:.1f}%"
+        + (f"ok {ok_rate * 100:.1f}%" if ok_rate is not None else "ok n/a")
     )
     print(json.dumps(row))
     return row
@@ -309,14 +381,30 @@ async def main() -> int:
              "written into the spawned service's params and stamped into "
              "every result row. With --base it only stamps the rows — the "
              "target's own config decides what actually runs")
+    ap.add_argument(
+        "--mix", default=None, choices=("multisize",),
+        help="traffic-mix scenario: 'multisize' = ONE source requested "
+             "at a Zipf-distributed ladder of crop sizes, every request "
+             "a distinct uncached key — the derivative-reuse pattern "
+             "(docs/caching.md). Reports ancestor-hit ratio and the "
+             "p50/p99 split between reuse=hit and reuse=miss rows")
+    ap.add_argument(
+        "--mix-requests", type=int, default=300,
+        help="requests in the --mix multisize leg")
+    ap.add_argument(
+        "--reuse", default=None, choices=("on", "off"),
+        help="derivative-reuse rewriter for the spawned service "
+             "(reuse_enable; docs/caching.md), stamped into every result "
+             "row as reuse_enable. With --base it only stamps the rows")
     args = ap.parse_args()
 
     if args.base and args.spawn:
         print("--base and --spawn are mutually exclusive", file=sys.stderr)
         return 2
 
-    global _KERNEL_TAG
+    global _KERNEL_TAG, _REUSE_TAG
     _KERNEL_TAG = args.kernel
+    _REUSE_TAG = args.reuse
 
     proc = None
     store = None
@@ -343,6 +431,10 @@ async def main() -> int:
             fh.write("debug: true\n")
             if args.kernel is not None:
                 fh.write(f"resample_kernel: {args.kernel}\n")
+            if args.reuse is not None:
+                fh.write(
+                    f"reuse_enable: {'true' if args.reuse == 'on' else 'false'}\n"
+                )
             if store is not None:
                 fh.write(f"upload_dir: {os.path.join(store, 'out')}\n")
         spawn_cmd += ["--params", params_path]
@@ -484,6 +576,80 @@ async def main() -> int:
                         row["offered_rate_rps"] = rate
                         row["options"] = vopts
                         sweep.append(row)
+                        all_rows.append(row)
+
+            if args.mix == "multisize":
+                # ONE source, Zipf-distributed crop-size ladder, every
+                # request a distinct uncached key (q_ varies the derived
+                # name): the derivative-reuse traffic pattern. The w_800
+                # warm render seeds the pure ancestor; sizes <= half of
+                # it are reuse-eligible, larger ones exercise the
+                # unsafe->full-pipeline fallback (docs/caching.md).
+                anc = await client.get(f"{base}/upload/w_800,o_jpg/{src}")
+                if anc.status_code != 200:
+                    print(
+                        f"multisize: ancestor warm failed "
+                        f"({anc.status_code})", file=sys.stderr,
+                    )
+                    rc = 1
+                else:
+                    ladder = [100, 128, 160, 200, 256, 320, 400, 512, 640]
+                    weights = _zipf_weights(len(ladder))
+                    rng = np.random.default_rng(20260803)
+                    counts = {size: 0 for size in ladder}
+                    urls = []
+                    for _ in range(args.mix_requests):
+                        size = int(
+                            rng.choice(ladder, p=weights)
+                        )
+                        q = 89 - counts[size]
+                        if q < 2:
+                            continue  # that size's key space is spent
+                        counts[size] += 1
+                        h = int(size * 3 / 4)
+                        urls.append(
+                            f"{base}/upload/w_{size},h_{h},c_1,q_{q},"
+                            f"o_jpg/{src}"
+                        )
+                    samples, fails, elapsed = await _multisize_run(
+                        client, urls, args.conc
+                    )
+                    hits = [lat for lat, reused in samples if reused]
+                    misses = [lat for lat, reused in samples if not reused]
+                    ratio = (
+                        round(len(hits) / len(samples), 4) if samples else 0.0
+                    )
+                    print(
+                        f"multisize: {len(samples)} ok / {fails} failed, "
+                        f"ancestor-hit ratio {ratio}"
+                    )
+                    for leg, lat in (("hit", hits), ("miss", misses)):
+                        if not lat:
+                            # an empty leg (e.g. no hits with --reuse
+                            # off) is an absent curve, NOT a saturated
+                            # run — _report's all-failed row would read
+                            # as an overload knee to artifact consumers
+                            print(f"multisize reuse-{leg}: no samples")
+                            continue
+                        row = _report(
+                            "multisize", f"reuse-{leg}", lat, 0,
+                            max(elapsed, 1e-9),
+                            extra={
+                                "reuse": leg,
+                                "ancestor_hit_ratio": ratio,
+                                # the legs interleave in ONE closed
+                                # loop: the wall clock is shared and a
+                                # failed request carries no reuse
+                                # header, so per-leg throughput/success
+                                # cannot be attributed honestly — the
+                                # split rows carry latency only, with
+                                # run-level figures alongside
+                                "throughput_rps": None,
+                                "success_rate": None,
+                                "run_failures": fails,
+                                "run_elapsed_s": round(elapsed, 3),
+                            },
+                        )
                         all_rows.append(row)
 
             # end-of-run attribution: batch efficiency + per-plan cost +

@@ -45,6 +45,14 @@ banded regression through, and vice versa once banded is the default).
 requested kernel section reports it as ``missing`` without failing, so
 schema-1/2 baselines stay checkable until refreshed.
 
+**Schema 4** adds the ``reuse_hit`` stage: the handler's own end-to-end
+serve time for a cache miss answered by the derivative-reuse rewriter
+(docs/caching.md) — a second handler with ``reuse_enable`` on renders
+distinct targets from a seeded pure ancestor, and the measured
+``timings["reuse_hit"]`` is gated like every other stage, so later PRs
+cannot silently regress the reuse path. Pre-schema-4 baselines report
+the row as ``missing`` without failing.
+
 CI: the ``perf-gate`` job runs ``--check`` with wide, CI-noise-tolerant
 bands (see .github/workflows/ci.yml). Baseline refresh policy:
 benchmarks/README.md.
@@ -66,7 +74,7 @@ sys.path.insert(0, REPO_ROOT)
 DEFAULT_BASELINE = os.path.join(
     REPO_ROOT, "benchmarks", "perf_baseline.json"
 )
-STAGES = ("decode", "device", "encode", "total", "cache_hit")
+STAGES = ("decode", "device", "encode", "total", "cache_hit", "reuse_hit")
 # per-plan cost figures gated alongside the latency stages (schema 2);
 # cost analysis is deterministic per jax version, so its band is tight
 COST_FIELDS = ("flops_total", "bytes_total")
@@ -74,7 +82,7 @@ COST_FIELDS = ("flops_total", "bytes_total")
 # stages on shared runners jitter by fractions of a ms that no relative
 # band should be asked to absorb
 ABS_SLACK_MS = 2.0
-SCHEMA = 3
+SCHEMA = 4
 # the resample-kernel variants each baseline carries a column for
 # (ops/resample.py KERNEL_MODES minus 'auto', which resolves to one of
 # these per geometry and would gate nothing new)
@@ -221,6 +229,37 @@ def measure(repeats: int = 30, warmup: int = 3,
             result = handler.process_image("w_40,h_30,o_png", src_path)
             rows["cache_hit"].append(time.perf_counter() - t0)
             assert result.from_cache
+
+        # cost-snapshot scope closes HERE: the plan_cost figures gate the
+        # micro-suite's own device programs; the reuse leg below compiles
+        # its own (ancestor + from-ancestor geometries) which are timed
+        # but not cost-gated — its latency column is the gate
+        keys_suite = {row["key"] for row in get_ledger().entries()}
+
+        # reuse-hit path (schema 4; docs/caching.md): a second handler
+        # with the rewriter on, one seeded pure ancestor, then distinct
+        # targets (q_ varies the derived key) each served from the
+        # ancestor's pixels — the handler's own timings["reuse_hit"] is
+        # the gated figure
+        params_reuse = AppParameters({
+            "tmp_dir": os.path.join(tmp, "rt"),
+            "upload_dir": os.path.join(tmp, "ru"),
+            "batch_deadline_ms": 0.5,
+            "reuse_enable": True,
+        })
+        handler_reuse = ImageHandler(
+            LocalStorage(params_reuse), params_reuse, batcher=batcher
+        )
+        reuse_src = os.path.join(tmp, "reuse-source.png")
+        with open(reuse_src, "wb") as fh:
+            fh.write(data)
+        handler_reuse.process_image("w_96,o_png", reuse_src)  # ancestor
+        for i in range(repeats):
+            result = handler_reuse.process_image(
+                f"w_40,h_30,c_1,q_{88 - i},o_png", reuse_src
+            )
+            assert result.reused_from, "perf-gate reuse leg missed"
+            rows["reuse_hit"].append(result.timings["reuse_hit"])
     finally:
         if injector is not None:
             faults.clear()
@@ -233,7 +272,8 @@ def measure(repeats: int = 30, warmup: int = 3,
     # (and not gated) when the backend returned no cost analysis.
     suite_rows = [
         row for row in get_ledger().entries()
-        if row["key"] not in keys_before and row["costed"]
+        if row["key"] not in keys_before and row["key"] in keys_suite
+        and row["costed"]
     ]
     plan_cost = {
         "programs": len(suite_rows),

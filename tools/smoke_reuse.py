@@ -1,0 +1,181 @@
+"""CI derivative-reuse smoke: boot the app with the variant index +
+cache-aware rewriter enabled and prove the reuse loop end to end
+(docs/caching.md):
+
+- render a LARGE rendition, then a small one of the same source: the
+  small render serves as a reuse hit — ``X-Flyimg-Reuse`` header, a
+  ``reuse.ancestor_hit`` span event on its trace, and NO ``fetch`` span
+  (the origin was never touched),
+- ``flyimg_reuse_hits_total{outcome="hit"}`` increments and
+  ``flyimg_variant_index_entries`` is populated,
+- the served reuse bytes are within 2 u8 of the same request rendered
+  from source by a reuse-OFF app (parity on the wire, not just in unit
+  tests),
+- the reuse-OFF app emits no reuse header (byte-identical-off contract).
+
+    JAX_PLATFORMS=cpu python tools/smoke_reuse.py
+
+Exit code 0 = every assertion held. The behavioral matrix (safety rules,
+generation caps, index bounds/TTL/persistence, brownout widening) lives
+in tests/test_reuse.py; this script proves the assembled service —
+handler fast path, tracing, metrics, response headers — reuses as one
+system.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import tempfile
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def _require(cond: bool, what: str) -> None:
+    if not cond:
+        print(f"FAIL: {what}", file=sys.stderr)
+        raise SystemExit(1)
+
+
+def _metric_value(text: str, name: str) -> float:
+    for line in text.splitlines():
+        if line.startswith(name + " "):
+            try:
+                return float(line.rsplit(" ", 1)[1])
+            except ValueError:
+                continue
+    return float("nan")
+
+
+def _span_names(node: dict, out: list) -> list:
+    out.append(node.get("name"))
+    for child in node.get("children", ()):
+        _span_names(child, out)
+    return out
+
+
+def _span_events(node: dict, out: list) -> list:
+    for event in node.get("events", ()):
+        out.append(event.get("name"))
+    for child in node.get("children", ()):
+        _span_events(child, out)
+    return out
+
+
+async def main() -> int:
+    import numpy as np
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from flyimg_tpu.appconfig import AppParameters
+    from flyimg_tpu.codecs import decode, encode
+    from flyimg_tpu.service.app import make_app
+
+    tmp = tempfile.mkdtemp(prefix="flyimg-reuse-smoke-")
+    # smooth gradient source: the resample-twice parity bound is a pixel
+    # statement, and gradients are the honest (non-adversarial) case
+    yy, xx = np.mgrid[0:384, 0:512].astype(np.float32)
+    rgb = np.stack(
+        [xx * (255.0 / 511.0), yy * (255.0 / 383.0),
+         (xx + yy) * (255.0 / 894.0)],
+        axis=-1,
+    ).astype(np.uint8)
+    src = os.path.join(tmp, "src.png")
+    with open(src, "wb") as fh:
+        fh.write(encode(rgb, "png"))
+
+    def params(sub: str, reuse: bool) -> AppParameters:
+        return AppParameters({
+            "tmp_dir": os.path.join(tmp, sub, "t"),
+            "upload_dir": os.path.join(tmp, sub, "u"),
+            "debug": True,
+            "reuse_enable": reuse,
+        })
+
+    app_on = make_app(params("on", True))
+    app_off = make_app(params("off", False))
+    on = TestClient(TestServer(app_on))
+    off = TestClient(TestServer(app_off))
+    await on.start_server()
+    await off.start_server()
+    try:
+        target = "w_120,h_90,c_1,o_png"
+
+        # 1) seed the ancestor (pure full-frame resample)
+        big = await on.get(f"/upload/w_256,o_png/{src}")
+        _require(big.status == 200, f"ancestor render 200 (got {big.status})")
+        _require(
+            "X-Flyimg-Reuse" not in big.headers,
+            "ancestor render itself is not a reuse hit",
+        )
+        metrics_text = await (await on.get("/metrics")).text()
+        _require(
+            _metric_value(metrics_text, "flyimg_variant_index_entries") >= 1,
+            "variant index populated after the ancestor store",
+        )
+
+        # 2) the small render is a reuse hit: header + span evidence
+        small = await on.get(f"/upload/{target}/{src}")
+        _require(small.status == 200, f"reuse render 200 ({small.status})")
+        _require(
+            "X-Flyimg-Reuse" in small.headers,
+            f"X-Flyimg-Reuse header on the reuse hit "
+            f"(headers {dict(small.headers)})",
+        )
+        traceparent = small.headers.get("traceparent", "")
+        trace_id = traceparent.split("-")[1] if "-" in traceparent else ""
+        _require(bool(trace_id), "reuse response carries a traceparent")
+        tree = json.loads(
+            await (await on.get(f"/debug/traces/{trace_id}")).text()
+        )
+        names: list = []
+        events: list = []
+        for root in tree["spans"]:
+            _span_names(root, names)
+            _span_events(root, events)
+        _require(
+            "reuse.ancestor_hit" in events,
+            f"reuse.ancestor_hit span event present (events {events})",
+        )
+        _require(
+            "fetch" not in names,
+            f"NO fetch span on the reuse hit — origin never touched "
+            f"(spans {names})",
+        )
+
+        # 3) metrics moved
+        metrics_text = await (await on.get("/metrics")).text()
+        _require(
+            _metric_value(
+                metrics_text, 'flyimg_reuse_hits_total{outcome="hit"}'
+            ) == 1.0,
+            "flyimg_reuse_hits_total{outcome=hit} == 1",
+        )
+
+        # 4) wire parity vs the reuse-off app (same request from source)
+        base = await off.get(f"/upload/{target}/{src}")
+        _require(base.status == 200, f"from-source render 200 ({base.status})")
+        _require(
+            "X-Flyimg-Reuse" not in base.headers,
+            "no reuse header from the reuse-off app",
+        )
+        got = decode(await small.read()).rgb.astype(int)
+        want = decode(await base.read()).rgb.astype(int)
+        _require(got.shape == want.shape, "reuse/from-source dims agree")
+        diff = int(np.abs(got - want).max())
+        _require(diff <= 2, f"served reuse bytes within 2 u8 (max {diff})")
+
+        print(
+            "reuse smoke OK: ancestor seeded, reuse hit served with no "
+            f"fetch span, parity max diff {diff} u8, counters moved"
+        )
+        return 0
+    finally:
+        await on.close()
+        await off.close()
+
+
+if __name__ == "__main__":
+    raise SystemExit(asyncio.run(main()))
