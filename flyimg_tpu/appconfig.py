@@ -52,6 +52,27 @@ SERVER_DEFAULTS: Dict[str, Any] = {
     # host-codec batch controller (native DecodePool JPEG-miss decode)
     "decode_batch_max": 32,
     "decode_deadline_ms": 1.0,
+    # --- host codec overhaul (docs/host-pipeline.md). Both knobs default
+    # OFF: serving is byte-identical to the pre-overhaul behavior
+    # (pinned by tests/test_roi_decode.py + tests/test_host_pipeline.py) ---
+    # ROI JPEG decode: crop/extract-dominant plans decode only the source
+    # window they consume (libjpeg-turbo crop/skip scanlines, composable
+    # with the DCT prescale; PIL decode+crop fallback)
+    "decode_roi": False,
+    # pipelined stage DAG (runtime/hostpipeline.py): bounded per-stage
+    # worker pools for the miss path's host work, with admission-gate
+    # backpressure instead of silent queueing
+    "host_pipeline_enable": False,
+    "host_pipeline_fetch_workers": 4,
+    "host_pipeline_decode_workers": 2,
+    "host_pipeline_encode_workers": 2,
+    # per-stage queue bound beyond the workers (pending > workers +
+    # queue_depth sheds 503 + Retry-After through the admission gate)
+    "host_pipeline_queue_depth": 16,
+    # a stage worker stuck inside one task longer than this is abandoned
+    # and replaced (same self-healing posture as the batch executor);
+    # 0 disables the wedge check
+    "host_pipeline_wedge_timeout_s": 60.0,
     # serving resample kernel (ops/resample.py; docs/kernels.md):
     # 'dense' = the shipped [out, in] weight-matrix einsums; 'banded' =
     # static K-tap gather-contract (~30x fewer resample MACs at serving

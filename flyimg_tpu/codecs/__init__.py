@@ -87,13 +87,29 @@ def decode(
     target_hint: Optional[Tuple[int, int]] = None,
     frame: int = 0,
     info: Optional[MediaInfo] = None,
+    roi: Optional[Tuple[int, int, int, int]] = None,
 ) -> DecodedImage:
     """Decode bytes -> DecodedImage. JPEG/WebP ride the native codec when
     built; everything else (and all alpha/animation handling) uses PIL.
     Alpha sources keep RAW rgb + a separate alpha plane; the handler
     flattens over the bg_ color only where alpha is actually dropped.
-    Pass ``info`` when the caller already probed the bytes."""
+    Pass ``info`` when the caller already probed the bytes.
+
+    ``roi`` (JPEG only; docs/host-pipeline.md) is a ``(x0, y0, x1, y1)``
+    window in POST-prescale coordinates — the same scale
+    ``jpeg_batch_scale_num(info, target_hint)`` selects — asking the
+    decoder to produce only that window (libjpeg-turbo crop/skip
+    scanlines natively; full decode + host crop on the PIL fallback).
+    The result then carries ``roi_offset``/``frame_size`` and the caller
+    MUST thread the offset to the device program as a span shift. Ignored
+    (full decode) for non-JPEG sources, EXIF-rotated sources (the window
+    coordinates would not survive the transpose), and any decode
+    failure."""
     info = info or media_info(data)
+    if roi is not None and info.mime == "image/jpeg" and frame == 0:
+        decoded = _decode_jpeg_roi(data, info, target_hint, roi)
+        if decoded is not None:
+            return decoded
     if native_codec.available():
         if info.mime == "image/jpeg":
             scale_num = jpeg_batch_scale_num(info, target_hint)
@@ -123,6 +139,41 @@ def decode(
     # ImageOps.exif_transpose (pil_codec.py:76), which honors PNG eXIf
     # and WebP EXIF; applying it again would double-rotate
     return pil_codec.decode(data, target_hint=target_hint, frame=frame)
+
+
+def _decode_jpeg_roi(
+    data: bytes, info: MediaInfo, target_hint, roi
+) -> Optional[DecodedImage]:
+    """One ROI decode attempt: native fc_jpeg_decode_roi when the turbo
+    build is loaded, else the PIL decode+crop fallback. None -> the
+    caller runs the normal full-frame path (EXIF-rotated sources, both
+    decoders failing)."""
+    if jpeg_orientation(data) != 1:
+        return None
+    scale_num = jpeg_batch_scale_num(info, target_hint)
+    x0, y0, x1, y1 = (int(v) for v in roi)
+    request = (x0, y0, x1 - x0, y1 - y0)
+    if request[2] <= 0 or request[3] <= 0:
+        return None
+    result = None
+    if native_codec.roi_supported():
+        result = native_codec.jpeg_decode_roi(data, scale_num, request)
+    if result is None:
+        try:
+            result = pil_codec.decode_jpeg_roi(data, scale_num, request)
+        except Exception:
+            result = None
+    if result is None:
+        return None
+    window, offset, frame_size = result
+    return DecodedImage(
+        rgb=np.ascontiguousarray(window),
+        alpha=None,
+        mime="image/jpeg",
+        orig_size=(info.width or frame_size[0], info.height or frame_size[1]),
+        roi_offset=offset,
+        frame_size=frame_size,
+    )
 
 
 def _orient_container(
@@ -174,20 +225,41 @@ def jpeg_batch_scale_num(data_info: MediaInfo, target_hint) -> int:
 def batch_jpeg_decode(items: list) -> list:
     """Aux-group runner: decode many JPEGs in ONE native pool call — C
     worker threads run in parallel regardless of Python thread counts.
-    ``items`` are (bytes, scale_num) with a uniform scale (the aux group
-    key carries it); returns oriented RGB arrays (None = fall back to the
-    single-image path)."""
+    ``items`` are ``(bytes, scale_num, roi)`` with a uniform scale (the
+    aux group key carries it); ``roi`` is None for a full-frame decode or
+    an ``(x0, y0, x1, y1)`` post-prescale window — submitters only set it
+    for orientation-1 sources (the handler's gate), so window results
+    skip the EXIF transpose. Full entries return oriented RGB arrays;
+    ROI entries return ``(rgb, (out_x, out_y), (full_w, full_h))`` with
+    the iMCU-actualized window geometry. None = fall back to the
+    single-image path."""
     pool = native_codec.get_pool()
     if pool is None:
         return [None] * len(items)
-    outs = pool.decode_batch([d for d, _ in items], items[0][1])
+    rois = []
+    for _, _, roi in items:
+        if roi is None:
+            rois.append(None)
+        else:
+            x0, y0, x1, y1 = (int(v) for v in roi)
+            rois.append((x0, y0, x1 - x0, y1 - y0))
+    outs = pool.decode_batch(
+        [d for d, _, _ in items], items[0][1], rois=rois
+    )
     results = []
-    for (data, _), rgb in zip(items, outs):
-        if rgb is None:
+    for (data, _, roi), decoded in zip(items, outs):
+        if decoded is None:
             results.append(None)
-            continue
-        orientation = jpeg_orientation(data)
-        results.append(np.ascontiguousarray(apply_orientation(rgb, orientation)))
+        elif isinstance(decoded, tuple):
+            window, offset, frame_size = decoded
+            results.append((
+                np.ascontiguousarray(window), offset, frame_size,
+            ))
+        else:
+            orientation = jpeg_orientation(data)
+            results.append(
+                np.ascontiguousarray(apply_orientation(decoded, orientation))
+            )
     return results
 
 

@@ -28,8 +28,19 @@
 
 #include <jpeglib.h>
 #include <png.h>
+#if defined(__has_include)
+#if __has_include(<webp/decode.h>)
 #include <webp/decode.h>
 #include <webp/encode.h>
+#else
+// runtime-only libwebp host (library present, -dev headers absent):
+// declare the handful of entry points we use against the stable .so.6 ABI
+#include "webp_shim.h"
+#endif
+#else
+#include <webp/decode.h>
+#include <webp/encode.h>
+#endif
 
 #include <atomic>
 #include <condition_variable>
@@ -159,6 +170,171 @@ uint8_t* fc_jpeg_decode(const uint8_t* data, size_t len, int scale_num,
   *width = w;
   *height = h;
   return out;
+}
+
+// ---------------------------------------------------------------------------
+// ROI decode: decode only the source window a crop/extract-dominant plan
+// actually consumes (libjpeg-turbo jpeg_crop_scanline + jpeg_skip_scanlines,
+// composable with the scale_num DCT prescale above). The thumbnail/cropzoom
+// firehose spends most of its decode time on pixels it throws away; this is
+// the decode-side twin of the resample's span window.
+// ---------------------------------------------------------------------------
+
+// 1 when this build can honor fc_jpeg_decode_roi (libjpeg-turbo >= 1.5
+// provides the crop/skip scanline API; plain libjpeg cannot).
+int fc_roi_supported() {
+#if defined(LIBJPEG_TURBO_VERSION)
+  return 1;
+#else
+  return 0;
+#endif
+}
+
+// Decode a sub-window of a JPEG to RGB. ``scale_num`` as in
+// fc_jpeg_decode; ``rx/ry/rw/rh`` are the requested window in OUTPUT
+// (post-prescale) coordinates. The decoded window may start left of and
+// be wider than requested: jpeg_crop_scanline aligns the left edge down
+// to an iMCU boundary and widens the span, so callers MUST consume the
+// actualized geometry reported back:
+//   width/height  — decoded window dims (the returned buffer's shape)
+//   out_x/out_y   — actual window origin in output coordinates
+//   full_w/full_h — the full scaled frame dims (what a windowless decode
+//                   of this source at this scale would have produced)
+// Rows above the window are entropy-skipped (no IDCT); rows below are
+// never read (jpeg_abort_decompress). CMYK/YCCK sources fold to RGB like
+// fc_jpeg_decode. Returns nullptr on any decode error or when the build
+// lacks the turbo API.
+uint8_t* fc_jpeg_decode_roi(const uint8_t* data, size_t len, int scale_num,
+                            int rx, int ry, int rw, int rh,
+                            int* width, int* height, int* out_x, int* out_y,
+                            int* full_w, int* full_h) {
+#if !defined(LIBJPEG_TURBO_VERSION)
+  (void)data; (void)len; (void)scale_num; (void)rx; (void)ry; (void)rw;
+  (void)rh; (void)width; (void)height; (void)out_x; (void)out_y;
+  (void)full_w; (void)full_h;
+  return nullptr;
+#else
+  jpeg_decompress_struct cinfo;
+  fc_jpeg_error_mgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = fc_jpeg_error_exit;
+  // volatile across the setjmp boundary, same reasoning as fc_jpeg_decode
+  uint8_t* volatile out = nullptr;
+  uint8_t* volatile row4 = nullptr;  // CMYK scanline scratch
+  if (setjmp(jerr.setjmp_buffer)) {
+    // error path for malformed/truncated bytes: abort + destroy releases
+    // every libjpeg allocation, and the worker thread running this task
+    // (fc_pool) returns to its loop untouched — pool abort safety is
+    // exactly this function never leaking or crashing on hostile input
+    jpeg_destroy_decompress(&cinfo);
+    std::free(out);
+    std::free(row4);
+    return nullptr;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, data, len);
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return nullptr;
+  }
+  const bool cmyk = cinfo.jpeg_color_space == JCS_CMYK ||
+                    cinfo.jpeg_color_space == JCS_YCCK;
+  cinfo.out_color_space = cmyk ? JCS_CMYK : JCS_RGB;
+  const bool inverted = cinfo.saw_Adobe_marker ||
+                        cinfo.jpeg_color_space == JCS_YCCK;
+  if (scale_num >= 1 && scale_num <= 8) {
+    cinfo.scale_num = scale_num;
+    cinfo.scale_denom = 8;
+  }
+  cinfo.do_fancy_upsampling = TRUE;
+  jpeg_start_decompress(&cinfo);
+  const int fw = static_cast<int>(cinfo.output_width);
+  const int fh = static_cast<int>(cinfo.output_height);
+  // clamp the requested window to the scaled frame (degenerate -> error)
+  if (rx < 0) { rw += rx; rx = 0; }
+  if (ry < 0) { rh += ry; ry = 0; }
+  if (rx + rw > fw) rw = fw - rx;
+  if (ry + rh > fh) rh = fh - ry;
+  if (rw <= 0 || rh <= 0 || rx >= fw || ry >= fh) {
+    jpeg_abort_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    return nullptr;
+  }
+  JDIMENSION xoff = static_cast<JDIMENSION>(rx);
+  JDIMENSION xw = static_cast<JDIMENSION>(rw);
+  if (xoff != 0 || xw != cinfo.output_width) {
+    // aligns xoff down to the (scaled) iMCU boundary and widens xw; a
+    // full-width request skips the call (crop_scanline rejects it)
+    jpeg_crop_scanline(&cinfo, &xoff, &xw);
+  }
+  const int w = static_cast<int>(xw);
+  const int stride = w * 3;
+  out = static_cast<uint8_t*>(
+      std::malloc(static_cast<size_t>(stride) * rh));
+  if (!out) {
+    jpeg_abort_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    return nullptr;
+  }
+  if (cmyk) {
+    row4 = static_cast<uint8_t*>(std::malloc(static_cast<size_t>(w) * 4));
+    if (!row4) {
+      jpeg_abort_decompress(&cinfo);
+      jpeg_destroy_decompress(&cinfo);
+      std::free(out);
+      return nullptr;
+    }
+  }
+  if (ry > 0) {
+    jpeg_skip_scanlines(&cinfo, static_cast<JDIMENSION>(ry));
+  }
+  int written = 0;
+  while (written < rh && cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row = out + static_cast<size_t>(written) * stride;
+    if (!cmyk) {
+      JSAMPROW rows[1] = {row};
+      written += static_cast<int>(jpeg_read_scanlines(&cinfo, rows, 1));
+      continue;
+    }
+    JSAMPROW rows[1] = {row4};
+    if (jpeg_read_scanlines(&cinfo, rows, 1) != 1) break;
+    for (int x = 0; x < w; ++x) {
+      const int c = row4[x * 4 + 0], m = row4[x * 4 + 1];
+      const int y = row4[x * 4 + 2], k = row4[x * 4 + 3];
+      if (inverted) {
+        row[x * 3 + 0] = static_cast<uint8_t>(c * k / 255);
+        row[x * 3 + 1] = static_cast<uint8_t>(m * k / 255);
+        row[x * 3 + 2] = static_cast<uint8_t>(y * k / 255);
+      } else {
+        row[x * 3 + 0] = static_cast<uint8_t>((255 - c) * (255 - k) / 255);
+        row[x * 3 + 1] = static_cast<uint8_t>((255 - m) * (255 - k) / 255);
+        row[x * 3 + 2] = static_cast<uint8_t>((255 - y) * (255 - k) / 255);
+      }
+    }
+    ++written;
+  }
+  std::free(row4);
+  row4 = nullptr;
+  if (written < rh) {
+    // truncated stream inside the window: the buffer is partial — fail
+    // rather than hand back rows of uninitialized memory
+    jpeg_abort_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    std::free(out);
+    return nullptr;
+  }
+  // the tail below the window is never needed: abort skips its entropy
+  // decode entirely (finish_decompress would insist on consuming it)
+  jpeg_abort_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  *width = w;
+  *height = rh;
+  *out_x = static_cast<int>(xoff);
+  *out_y = ry;
+  *full_w = fw;
+  *full_h = fh;
+  return out;
+#endif
 }
 
 // Luma sampling factors must satisfy the JPEG MCU budget (sum of h*v over
@@ -966,12 +1142,27 @@ struct fc_batch_item {
   const uint8_t* data;
   size_t len;
   int scale_num;
+  // requested ROI window in OUTPUT (post-prescale) coordinates;
+  // roi_w <= 0 means a full-frame decode. The actualized window geometry
+  // comes back in out_x/out_y/full_w/full_h (see fc_jpeg_decode_roi).
+  int roi_x;
+  int roi_y;
+  int roi_w;
+  int roi_h;
   uint8_t* out;
   int width;
   int height;
+  int out_x;
+  int out_y;
+  int full_w;
+  int full_h;
 };
 
 // Decode a batch of JPEGs in parallel on the pool; blocks until done.
+// Items may mix full-frame and ROI decodes (roi_w > 0); a per-item
+// failure (malformed/truncated bytes) nulls that item's `out` and the
+// worker thread survives — the error path in both decoders is a
+// setjmp-contained cleanup, never an abort of the process or the pool.
 void fc_pool_decode_jpeg_batch(fc_pool* pool, fc_batch_item* items, int n) {
   std::atomic<int> remaining{n};
   std::mutex done_mu;
@@ -981,8 +1172,16 @@ void fc_pool_decode_jpeg_batch(fc_pool* pool, fc_batch_item* items, int n) {
     {
       std::lock_guard<std::mutex> lock(pool->mu);
       pool->tasks.emplace([item, &remaining, &done_mu, &done_cv] {
-        item->out = fc_jpeg_decode(item->data, item->len, item->scale_num,
-                                   &item->width, &item->height);
+        if (item->roi_w > 0 && item->roi_h > 0) {
+          item->out = fc_jpeg_decode_roi(
+              item->data, item->len, item->scale_num, item->roi_x,
+              item->roi_y, item->roi_w, item->roi_h, &item->width,
+              &item->height, &item->out_x, &item->out_y, &item->full_w,
+              &item->full_h);
+        } else {
+          item->out = fc_jpeg_decode(item->data, item->len, item->scale_num,
+                                     &item->width, &item->height);
+        }
         if (remaining.fetch_sub(1) == 1) {
           std::lock_guard<std::mutex> dl(done_mu);
           done_cv.notify_all();

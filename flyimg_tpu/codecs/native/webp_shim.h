@@ -1,0 +1,72 @@
+// Minimal libwebp declarations for hosts that ship the runtime library
+// (libwebp.so.6) but not the -dev headers. Used by fastcodec.cpp only when
+// <webp/decode.h> is absent (#__has_include); a host with real headers
+// never sees this file.
+//
+// ABI notes: the only version-checked entry point we use is
+// WebPGetFeatures -> WebPGetFeaturesInternal(, WEBP_DECODER_ABI_VERSION);
+// libwebp compares the MAJOR byte only (WEBP_ABI_IS_INCOMPATIBLE checks
+// version >> 8), and 0x0208 is the decoder ABI of the 0.6.x/1.0.x series
+// that ships libwebp.so.6. The encode entry points are plain exported C
+// symbols with no version handshake. A mismatch fails closed:
+// WebPGetFeatures returns VP8_STATUS_INVALID_PARAM and the Python layer
+// falls back to PIL.
+
+#ifndef FASTCODEC_WEBP_SHIM_H_
+#define FASTCODEC_WEBP_SHIM_H_
+
+#include <cstddef>
+#include <cstdint>
+
+#define WEBP_DECODER_ABI_VERSION 0x0208
+
+typedef enum VP8StatusCode {
+  VP8_STATUS_OK = 0,
+  VP8_STATUS_OUT_OF_MEMORY,
+  VP8_STATUS_INVALID_PARAM,
+  VP8_STATUS_BITSTREAM_ERROR,
+  VP8_STATUS_UNSUPPORTED_FEATURE,
+  VP8_STATUS_SUSPENDED,
+  VP8_STATUS_USER_ABORT,
+  VP8_STATUS_NOT_ENOUGH_DATA
+} VP8StatusCode;
+
+typedef struct WebPBitstreamFeatures {
+  int width;
+  int height;
+  int has_alpha;
+  int has_animation;
+  int format;  // 0 = undefined/mixed, 1 = lossy, 2 = lossless
+  uint32_t pad[5];
+} WebPBitstreamFeatures;
+
+extern "C" {
+
+VP8StatusCode WebPGetFeaturesInternal(const uint8_t* data, size_t data_size,
+                                      WebPBitstreamFeatures* features,
+                                      int version);
+
+uint8_t* WebPDecodeRGBA(const uint8_t* data, size_t data_size, int* width,
+                        int* height);
+uint8_t* WebPDecodeRGB(const uint8_t* data, size_t data_size, int* width,
+                       int* height);
+
+size_t WebPEncodeRGB(const uint8_t* rgb, int width, int height, int stride,
+                     float quality_factor, uint8_t** output);
+size_t WebPEncodeRGBA(const uint8_t* rgba, int width, int height, int stride,
+                      float quality_factor, uint8_t** output);
+size_t WebPEncodeLosslessRGB(const uint8_t* rgb, int width, int height,
+                             int stride, uint8_t** output);
+size_t WebPEncodeLosslessRGBA(const uint8_t* rgba, int width, int height,
+                              int stride, uint8_t** output);
+
+}  // extern "C"
+
+static inline VP8StatusCode WebPGetFeatures(const uint8_t* data,
+                                            size_t data_size,
+                                            WebPBitstreamFeatures* features) {
+  return WebPGetFeaturesInternal(data, data_size, features,
+                                 WEBP_DECODER_ABI_VERSION);
+}
+
+#endif  // FASTCODEC_WEBP_SHIM_H_

@@ -23,9 +23,45 @@ _DIR = os.path.join(os.path.dirname(__file__), "native")
 _LIB_PATH = os.path.join(_DIR, "libfastcodec.so")
 _lib = None
 _lib_lock = threading.Lock()
+# Two distinct facts about the loaded library (set during _load):
+# _roi_symbol — the ROI entry points EXIST, which also means the library
+# was built with the widened fc_batch_item struct (the fields are
+# unconditional in fastcodec.cpp; only the ROI body is #if-gated), so it
+# decides the ctypes batch-item LAYOUT. _roi_supported — the build can
+# actually honor a window (fc_roi_supported(): libjpeg-turbo underneath),
+# so it decides whether ROI requests are forwarded. A fresh plain-libjpeg
+# build has the symbol (widened layout) but no support — conflating the
+# two would feed the narrow struct to code striding by the wide one.
+_roi_symbol = False
+_roi_supported = False
 
 
 class _BatchItem(ctypes.Structure):
+    # mirrors fc_batch_item in fastcodec.cpp: roi_w <= 0 = full decode;
+    # the actualized window geometry comes back in out_x/out_y/full_w/full_h
+    _fields_ = [
+        ("data", ctypes.c_char_p),
+        ("len", ctypes.c_size_t),
+        ("scale_num", ctypes.c_int),
+        ("roi_x", ctypes.c_int),
+        ("roi_y", ctypes.c_int),
+        ("roi_w", ctypes.c_int),
+        ("roi_h", ctypes.c_int),
+        ("out", ctypes.c_void_p),
+        ("width", ctypes.c_int),
+        ("height", ctypes.c_int),
+        ("out_x", ctypes.c_int),
+        ("out_y", ctypes.c_int),
+        ("full_w", ctypes.c_int),
+        ("full_h", ctypes.c_int),
+    ]
+
+
+class _BatchItemV1(ctypes.Structure):
+    # pre-ROI fc_batch_item layout: a stale prebuilt .so (no
+    # fc_jpeg_decode_roi symbol -> _roi_supported False) still expects
+    # this shape, and feeding it the widened struct would corrupt the
+    # call — layout chosen per-call in DecodePool.decode_batch
     _fields_ = [
         ("data", ctypes.c_char_p),
         ("len", ctypes.c_size_t),
@@ -80,6 +116,28 @@ def _load():
             ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int,
             ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
         ]
+        # ROI decode entry points are feature-gated: a stale prebuilt .so
+        # (no symbol -> old narrow batch struct) or a plain-libjpeg build
+        # (symbol present, fc_roi_supported() == 0 -> widened struct but
+        # no window decode) simply has callers fall back to full-frame
+        # decode + host crop
+        global _roi_symbol, _roi_supported
+        try:
+            lib.fc_jpeg_decode_roi.restype = ctypes.c_void_p
+            lib.fc_jpeg_decode_roi.argtypes = [
+                ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int,
+                ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+            ]
+            lib.fc_roi_supported.restype = ctypes.c_int
+            lib.fc_roi_supported.argtypes = []
+            _roi_symbol = True
+            _roi_supported = bool(lib.fc_roi_supported())
+        except AttributeError:
+            _roi_symbol = False
+            _roi_supported = False
         lib.fc_jpeg_encode.restype = ctypes.c_void_p
         lib.fc_jpeg_encode.argtypes = [
             ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
@@ -159,6 +217,46 @@ def jpeg_decode(
         return None
     arr = _take_buffer(lib, ptr, w.value * h.value * 3)
     return arr.reshape(h.value, w.value, 3)
+
+
+def roi_supported() -> bool:
+    """True when the loaded library can decode JPEG sub-windows
+    (fc_jpeg_decode_roi — needs a libjpeg-turbo build)."""
+    return bool(_load()) and _roi_supported
+
+
+def jpeg_decode_roi(
+    data: bytes, scale_num: int, roi: Tuple[int, int, int, int]
+) -> Optional[Tuple[np.ndarray, Tuple[int, int], Tuple[int, int]]]:
+    """Decode only a window of a JPEG: ``roi`` is ``(x, y, w, h)`` in
+    OUTPUT (post-prescale) coordinates. Returns ``(rgb, (out_x, out_y),
+    (full_w, full_h))`` where the decoded window may start left of and be
+    wider than requested (iMCU alignment) — ``out_x/out_y`` is the actual
+    origin and ``full_w/full_h`` the full scaled frame the window belongs
+    to. None on failure or when the build lacks the turbo crop API."""
+    lib = _load()
+    if not lib or not _roi_supported:
+        return None
+    w = ctypes.c_int()
+    h = ctypes.c_int()
+    ox = ctypes.c_int()
+    oy = ctypes.c_int()
+    fw = ctypes.c_int()
+    fh = ctypes.c_int()
+    ptr = lib.fc_jpeg_decode_roi(
+        data, len(data), scale_num,
+        int(roi[0]), int(roi[1]), int(roi[2]), int(roi[3]),
+        ctypes.byref(w), ctypes.byref(h), ctypes.byref(ox), ctypes.byref(oy),
+        ctypes.byref(fw), ctypes.byref(fh),
+    )
+    if not ptr:
+        return None
+    arr = _take_buffer(lib, ptr, w.value * h.value * 3)
+    return (
+        arr.reshape(h.value, w.value, 3),
+        (ox.value, oy.value),
+        (fw.value, fh.value),
+    )
 
 
 def jpeg_encode(
@@ -339,12 +437,26 @@ class DecodePool:
         self._pool = lib.fc_pool_create(n_threads or os.cpu_count() or 1)
 
     def decode_batch(
-        self, blobs: List[bytes], scale_num: int = 8
-    ) -> List[Optional[np.ndarray]]:
+        self,
+        blobs: List[bytes],
+        scale_num: int = 8,
+        rois: Optional[List[Optional[Tuple[int, int, int, int]]]] = None,
+    ) -> list:
+        """Decode many JPEGs in ONE pool call. Plain entries return an
+        RGB array (or None on per-image failure). ``rois`` (parallel to
+        ``blobs``; entries may be None) requests sub-window decodes in
+        OUTPUT coordinates — those entries return ``(rgb, (out_x, out_y),
+        (full_w, full_h))`` like :func:`jpeg_decode_roi`, with the same
+        iMCU-actualized geometry contract."""
         n = len(blobs)
         if n == 0:
             return []
-        items = (_BatchItem * n)()
+        # layout follows the SYMBOL (struct width); honoring windows
+        # follows the CAPABILITY — a plain-libjpeg rebuild has the
+        # widened struct with fc_roi_supported() == 0
+        roi_build = _roi_symbol
+        item_cls = _BatchItem if roi_build else _BatchItemV1
+        items = (item_cls * n)()
         keepalive = []
         for i, blob in enumerate(blobs):
             buf = ctypes.create_string_buffer(blob, len(blob))
@@ -352,15 +464,37 @@ class DecodePool:
             items[i].data = ctypes.cast(buf, ctypes.c_char_p)
             items[i].len = len(blob)
             items[i].scale_num = scale_num
-        self._lib.fc_pool_decode_jpeg_batch(self._pool, items, n)
-        out: List[Optional[np.ndarray]] = []
+            if roi_build:
+                roi = (
+                    rois[i] if rois is not None and _roi_supported else None
+                )
+                if roi is not None:
+                    items[i].roi_x = int(roi[0])
+                    items[i].roi_y = int(roi[1])
+                    items[i].roi_w = int(roi[2])
+                    items[i].roi_h = int(roi[3])
+                else:
+                    items[i].roi_w = 0
+                    items[i].roi_h = 0
+        self._lib.fc_pool_decode_jpeg_batch(
+            self._pool, ctypes.cast(items, ctypes.POINTER(_BatchItem)), n
+        )
+        out: list = []
         for i in range(n):
             if not items[i].out:
                 out.append(None)
                 continue
             w, h = items[i].width, items[i].height
             arr = _take_buffer(self._lib, items[i].out, w * h * 3)
-            out.append(arr.reshape(h, w, 3))
+            rgb = arr.reshape(h, w, 3)
+            if roi_build and items[i].roi_w > 0:
+                out.append((
+                    rgb,
+                    (items[i].out_x, items[i].out_y),
+                    (items[i].full_w, items[i].full_h),
+                ))
+            else:
+                out.append(rgb)
         return out
 
     def encode_batch(

@@ -39,6 +39,14 @@ class DecodedImage:
     mime: str
     orig_size: Tuple[int, int]           # (w, h) BEFORE any draft prescale
     n_frames: int = 1
+    # ROI decode (docs/host-pipeline.md): when set, ``rgb`` is only the
+    # window of the (possibly prescaled) frame starting at this (x, y)
+    # offset, and ``frame_size`` is the full (w, h) that frame would have
+    # had — the dims the plan must be built against, with the window
+    # offset threaded to the device program as a span shift. Both stay
+    # None on every full-frame decode path.
+    roi_offset: Optional[Tuple[int, int]] = None
+    frame_size: Optional[Tuple[int, int]] = None
 
     @property
     def size(self) -> Tuple[int, int]:
@@ -89,6 +97,46 @@ def decode(
     return DecodedImage(
         rgb=rgb, alpha=alpha, mime=mime, orig_size=orig_size, n_frames=n_frames
     )
+
+
+def decode_jpeg_roi(
+    data: bytes, scale_num: int, roi: Tuple[int, int, int, int]
+) -> Optional[Tuple[np.ndarray, Tuple[int, int], Tuple[int, int]]]:
+    """Pure-Python fallback for the native ROI decode: full (draft-
+    prescaled) decode, then a host crop to the requested window. Same
+    return contract as ``native_codec.jpeg_decode_roi`` — ``(rgb,
+    (out_x, out_y), (full_w, full_h))`` — except the window is exactly
+    the requested one (a post-decode crop has no iMCU constraint). The
+    downstream win (smaller device input, smaller pipeline payload)
+    survives even though the decode itself still pays the full frame.
+
+    ``roi`` is ``(x, y, w, h)`` in POST-prescale coordinates:
+    ``scale_num``/8 must be the same DCT scale the caller derived the
+    window under (``jpeg_batch_scale_num``), and PIL's draft at the
+    exact ceil-scaled dims selects exactly that scale.
+    """
+    img = Image.open(io.BytesIO(data))
+    if img.format != "JPEG":
+        return None
+    if 1 <= scale_num < 8:
+        sw = (img.size[0] * scale_num + 7) // 8
+        sh = (img.size[1] * scale_num + 7) // 8
+        img.draft("RGB", (sw, sh))
+    arr = np.asarray(img.convert("RGB"))
+    fh, fw = arr.shape[:2]
+    x, y, w, h = (int(v) for v in roi)
+    if x < 0:
+        w += x
+        x = 0
+    if y < 0:
+        h += y
+        y = 0
+    w = min(w, fw - x)
+    h = min(h, fh - y)
+    if w <= 0 or h <= 0:
+        return None
+    window = np.ascontiguousarray(arr[y:y + h, x:x + w])
+    return window, (x, y), (fw, fh)
 
 
 def encode(

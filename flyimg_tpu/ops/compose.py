@@ -468,15 +468,41 @@ def bucket_batch(n: int) -> int:
     return 1 << max(n - 1, 0).bit_length()
 
 
-def run_plan(image: np.ndarray, plan: TransformPlan) -> np.ndarray:
+def run_plan(
+    image: np.ndarray,
+    plan: TransformPlan,
+    src_window: Optional[Tuple[int, int]] = None,
+) -> np.ndarray:
     """Execute a plan on one host image [h, w, 3] uint8 -> uint8 output.
 
     Pads the input up to a shape bucket so repeated calls with same-signature
     plans and similar sizes reuse one compiled program; the pad region is
     masked out of the resample by construction.
+
+    ``src_window`` (docs/host-pipeline.md "ROI window math"): the image is
+    only the window of the plan's source starting at this (x, y) offset —
+    the ROI-decode contract. The source spans are per-image TRACED inputs,
+    so shifting them by the offset reproduces the full-frame sampling
+    bit-for-bit on the window array (the decode window includes the tap
+    support margin by construction); program identity is untouched.
     """
     h, w = int(image.shape[0]), int(image.shape[1])
-    if plan.src_size != (w, h):
+    if src_window is not None:
+        wx, wy = int(src_window[0]), int(src_window[1])
+        if (
+            wx < 0 or wy < 0
+            or wx + w > plan.src_size[0] or wy + h > plan.src_size[1]
+        ):
+            raise ValueError(
+                f"src_window {(wx, wy)} + image {(w, h)} exceeds plan "
+                f"src {plan.src_size}"
+            )
+        if not _needs_resample(plan, None):
+            # only the windowed-resample path consumes spans; a pixel-op
+            # or bare-rotate plan reads the whole frame and a window
+            # would silently produce window-sized output
+            raise ValueError("src_window requires a resample/extract plan")
+    elif plan.src_size != (w, h):
         # geometry (pns clamping, fill dims, extract clamps) was resolved
         # against plan.src_size; silently patching it here would run a stale
         # plan. Callers must rebuild the plan for the actual decoded dims.
@@ -485,6 +511,15 @@ def run_plan(image: np.ndarray, plan: TransformPlan) -> np.ndarray:
             "rebuild the plan with build_plan(options, w, h)"
         )
     layout = plan_layout(plan)
+    if src_window is not None:
+        layout = Layout(
+            (layout.span_y[0] - wy, layout.span_y[1]),
+            (layout.span_x[0] - wx, layout.span_x[1]),
+            layout.out_true,
+            layout.resample_out,
+            layout.pad_canvas,
+            layout.pad_offset,
+        )
 
     slice_out = None
     band = None

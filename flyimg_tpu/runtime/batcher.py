@@ -185,6 +185,10 @@ class _Pending:       # ndarray fields ("truth value is ambiguous" in any
     parent_span_id: Optional[str] = None
     # lazily computed quarantine digest (poison paths only)
     fp_digest: Optional[str] = None
+    # ROI decode (docs/host-pipeline.md): `image` is only the window of
+    # the plan's source starting at this (x, y) offset; _assemble shifts
+    # the member's TRACED spans by it — program identity is untouched
+    src_window: Optional[Tuple[int, int]] = None
 
 
 @dataclass
@@ -344,17 +348,45 @@ class BatchController:
 
     # ------------------------------------------------------------------
 
-    def submit(self, image: np.ndarray, plan: TransformPlan) -> Future:
-        """Queue one image+plan; resolves to the uint8 output array."""
+    def submit(
+        self,
+        image: np.ndarray,
+        plan: TransformPlan,
+        src_window: Optional[Tuple[int, int]] = None,
+    ) -> Future:
+        """Queue one image+plan; resolves to the uint8 output array.
+
+        ``src_window`` (docs/host-pipeline.md "ROI window math"): the
+        image is only the window of the plan's source at this (x, y)
+        offset — the ROI-decode contract. Spans are per-member traced
+        inputs, so ``_assemble`` shifting them by the offset reproduces
+        the full-frame sampling exactly; the window's (smaller) bucketed
+        in_shape keys its own program like any other input shape."""
         h, w = int(image.shape[0]), int(image.shape[1])
-        if plan.src_size != (w, h):
-            raise ValueError("plan src_size does not match image dims")
-        layout = plan_layout(plan)
         needs_resample = (
             plan.resize_to is not None
             or plan.extent is not None
             or plan.extract is not None
         )
+        if src_window is not None:
+            wx, wy = int(src_window[0]), int(src_window[1])
+            if (
+                wx < 0 or wy < 0
+                or wx + w > plan.src_size[0] or wy + h > plan.src_size[1]
+            ):
+                raise ValueError(
+                    f"src_window {(wx, wy)} + image {(w, h)} exceeds "
+                    f"plan src {plan.src_size}"
+                )
+            if not needs_resample:
+                # only the windowed resample consumes spans; a pixel-op
+                # or bare-rotate plan reads the whole frame
+                raise ValueError(
+                    "src_window requires a resample/extract plan"
+                )
+        elif plan.src_size != (w, h):
+            raise ValueError("plan src_size does not match image dims")
+        layout = plan_layout(plan)
         # arbitrary-angle rotate runs shape-bucketed with traced geometry
         # (rotate_image_dynamic) UNLESS (a) an extent pad fixed the frame
         # to a static canvas first — the static rotate is already shared —
@@ -439,6 +471,7 @@ class BatchController:
             parent_span_id=(
                 submit_span.span_id if submit_span is not None else None
             ),
+            src_window=src_window,
         )
         base_key = key
         # quarantine short-circuit: recently-poison work executes as a
@@ -1172,6 +1205,12 @@ class BatchController:
                 in_true[i, 2:] = member.final_true
             span_y[i] = layout.span_y
             span_x[i] = layout.span_x
+            if member.src_window is not None:
+                # ROI decode: the member's pixels are a window of the
+                # plan's source — shift the traced span origins so the
+                # resample samples the same absolute positions
+                span_x[i, 0] -= member.src_window[0]
+                span_y[i, 0] -= member.src_window[1]
             out_true[i] = layout.out_true
         for i in range(n, batch):  # pad slots repeat the last member
             images[i] = images[n - 1]

@@ -156,6 +156,7 @@ class BrownoutEngine:
         self._slo = None
         self._inflight_fn: Optional[Callable[[], float]] = None
         self._breaker_open_fn: Optional[Callable[[], float]] = None
+        self._host_pipeline = None
         self.refresh = RefreshQueue(
             max_pending=refresh_max_pending, metrics=metrics
         )
@@ -201,16 +202,19 @@ class BrownoutEngine:
         self._transition_listeners.append(listener)
 
     def attach(self, *, batchers=(), slo=None, inflight_fn=None,
-               breaker_open_fn=None) -> None:
+               breaker_open_fn=None, host_pipeline=None) -> None:
         """Wire the live pressure sources (service/app.py): batch
         controllers (queue depth + efficiency window), the SLO engine
-        (burn rates), the inflight-request gauge, and the breaker
-        registry's open count. All optional — a missing source simply
-        contributes no pressure."""
+        (burn rates), the inflight-request gauge, the breaker registry's
+        open count, and the host stage-DAG (runtime/hostpipeline.py —
+        its worst stage-pool saturation, 1.0 = a stage at its admission
+        bound). All optional — a missing source simply contributes no
+        pressure."""
         self._batchers = tuple(batchers)
         self._slo = slo
         self._inflight_fn = inflight_fn
         self._breaker_open_fn = breaker_open_fn
+        self._host_pipeline = host_pipeline
 
     def register_metrics(self, registry) -> None:
         """Render-time gauges on the shared registry: the level an
@@ -263,6 +267,17 @@ class BrownoutEngine:
             out["burn_slow"] = slow / max(
                 self._slo.burn_threshold_slow, 1e-9
             )
+        if (
+            self._host_pipeline is not None
+            and getattr(self._host_pipeline, "enabled", False)
+        ):
+            try:
+                # worst stage-pool saturation (pending / admission
+                # bound): a saturated decode pool is host overload the
+                # batcher queues can't see (runtime/hostpipeline.py)
+                out["host_stage"] = float(self._host_pipeline.pressure())
+            except Exception:
+                pass
         # a failing pressure source degrades to no-signal: the engine
         # must never turn a broken gauge callback into per-request 500s
         if self._inflight_fn is not None and self.inflight_ref > 0:

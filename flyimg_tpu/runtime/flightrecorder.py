@@ -110,9 +110,15 @@ class FlightRecorder:
         kind: str = "primary",
         trace_id: Optional[str] = None,
         error: Optional[str] = None,
+        stage: Optional[str] = None,
     ) -> None:
         """One launch outcome. Runs on the batcher's executor/drain
-        threads — the body is one level sample plus a deque append."""
+        threads — the body is one level sample plus a deque append.
+        ``stage`` is set on host-pipeline ``host_stage`` records
+        (runtime/hostpipeline.py): the per-stage queue-wait joins the
+        device launches' h2d/dispatch/sync split in the same ring, so an
+        incident dump shows where requests queued — host stage pools or
+        device — on one timeline."""
         level = None
         if self._level_fn is not None:
             try:
@@ -138,6 +144,7 @@ class FlightRecorder:
             "compile_hit": compile_hit,
             "brownout_level": level,
             "kind": kind,
+            "stage": stage,
             "trace_id": trace_id,
             "error": error,
         }

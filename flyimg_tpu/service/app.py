@@ -320,10 +320,25 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
             "brownout_escalation", context=info
         )
     )
+    # pipelined host stage DAG (runtime/hostpipeline.py;
+    # docs/host-pipeline.md): bounded fetch/decode/encode worker pools
+    # with admission-gate backpressure. Inert (no pools, no gauges, no
+    # new behavior) with host_pipeline_enable off.
+    from flyimg_tpu.runtime.hostpipeline import HostPipeline
+
+    host_pipeline = HostPipeline.from_params(
+        params, metrics=metrics, flight_recorder=flight_recorder
+    )
+    for pool_name, stage_pool in host_pipeline.pools():
+        metrics.gauge(
+            f'flyimg_host_pool_queue_depth{{pool="{pool_name}"}}',
+            "Pending (queued or executing) tasks per host stage pool",
+            fn=lambda p=stage_pool: float(p.pending),
+        )
     handler = ImageHandler(
         storage, params, batcher=batcher, codec_batcher=codec_batcher,
         face_backend=face_backend, metrics=metrics, sp_mesh=sp_mesh,
-        brownout=brownout,
+        brownout=brownout, host_pipeline=host_pipeline,
     )
     # state gauges (runtime/metrics.py Gauge): sampled at /metrics render
     inflight = metrics.gauge(
@@ -381,6 +396,9 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
         # LIVE value each evaluation, not the attach-time float
         inflight_fn=lambda: inflight.value,
         breaker_open_fn=handler.fetch_policy.breakers.open_count,
+        # stage-DAG saturation (worst pool pending/bound): host overload
+        # the batcher queues cannot see feeds the same brownout ladder
+        host_pipeline=host_pipeline,
     )
 
     @web.middleware
@@ -503,6 +521,7 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
         draining["flag"] = True  # direct-cleanup callers flip it too
         batcher.close(drain_timeout_s)
         codec_batcher.close(drain_timeout_s)
+        host_pipeline.close(drain_timeout_s)
         if injector is not None:
             from flyimg_tpu.testing import faults
 
@@ -779,8 +798,15 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
         denied = _debug_gate_404()
         if denied is not None:
             return denied
+        doc = metrics.perf_snapshot()
+        # stage-DAG occupancy/queue depth (runtime/hostpipeline.py):
+        # null when the pipeline is off, per-pool workers/busy/pending
+        # when on — the same document the bench harness scrapes
+        doc["host_pipeline"] = (
+            host_pipeline.snapshot() if host_pipeline.enabled else None
+        )
         return web.Response(
-            text=_json.dumps(metrics.perf_snapshot()),
+            text=_json.dumps(doc),
             content_type="application/json",
         )
 

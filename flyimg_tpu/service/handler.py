@@ -45,6 +45,7 @@ from flyimg_tpu.spec.options import OptionsBag
 from flyimg_tpu.spec.plan import (
     TransformPlan,
     build_plan,
+    decode_roi_window,
     decode_target_hint,
     degrade_plan,
     lossy_output,
@@ -144,6 +145,7 @@ class ImageHandler:
         metrics=None,
         sp_mesh=None,
         brownout=None,
+        host_pipeline=None,
     ) -> None:
         self.storage = storage
         self.params = params
@@ -198,6 +200,46 @@ class ImageHandler:
             params.by_key("reuse_degraded_min_scale", 1.3)
         )
         self.variants = VariantIndex.from_params(params, storage=storage)
+        # ROI JPEG decode (docs/host-pipeline.md): crop/extract-dominant
+        # plans decode only the source window they consume (decode_roi
+        # knob; default off = byte-identical full decodes, pinned by
+        # tests/test_roi_decode.py)
+        self.decode_roi = bool(params.by_key("decode_roi", False))
+        # pipelined stage DAG (runtime/hostpipeline.py): bounded
+        # per-stage pools for fetch/decode/encode host work. None or
+        # disabled = today's inline stages exactly.
+        self.host_pipeline = host_pipeline
+
+    def _stage(self, name: str, fn, deadline: Optional[Deadline],
+               *, inline_fallback: bool = True):
+        """Run one host stage through its pipeline pool when the stage
+        DAG is on; inline otherwise. A stage-pool TIMEOUT (wedged or
+        saturated workers) degrades to running the work inline in this
+        request thread (``inline_fallback``, counted as a wedge like the
+        batcher fallbacks) or sheds as a typed 503; a stage-pool SHED
+        (admission bound) propagates as the 503 the pool raised. Any
+        exception from ``fn`` itself surfaces unchanged either way."""
+        pipeline = self.host_pipeline
+        if pipeline is None or not getattr(pipeline, "enabled", False):
+            return fn()
+        try:
+            return pipeline.run(
+                name, fn, timeout=self._device_wait_s(deadline),
+            )
+        except (FutureTimeout, TimeoutError):
+            # FutureTimeout: our bounded wait expired on a busy stage.
+            # Builtin TimeoutError: the pool itself failed the task — a
+            # wedged worker abandoned by self-healing, or a shutdown
+            # drain stranding it (distinct classes before Python 3.11).
+            # Both degrade the same way.
+            if deadline is not None:
+                deadline.check(name)
+            self._record_wedge()
+            if inline_fallback:
+                return fn()
+            raise ServiceUnavailableException(
+                f"host {name} stage did not produce a result in time"
+            ) from None
 
     # lazily import model backends so the service can run without them
     def _smartcrop(self):
@@ -544,22 +586,29 @@ class ImageHandler:
         """The origin fetch + ingest step (service/input_source.py) with
         its span + stage timing — ONE copy shared by the eager path, the
         reuse fallback (lazy, inside the leader), and the background
-        stale refresh."""
+        stale refresh. With the stage DAG on it runs on the bounded
+        fetch I/O pool (a saturated/wedged pool sheds 503 instead of
+        silently stacking origin connections on request threads)."""
         t = time.perf_counter()
-        with tracing.span("fetch") as fetch_span:
-            source = load_source(
-                image_src,
-                options,
-                self.params.by_key("tmp_dir", "var/tmp"),
-                header_extra_options=self.params.by_key(
-                    "header_extra_options", ""
-                ),
-                policy=self.fetch_policy,
-                deadline=deadline,
-            )
-            if fetch_span is not None:
-                fetch_span.set_attribute("source.bytes", len(source.data))
-                fetch_span.set_attribute("source.mime", source.info.mime)
+
+        def _fetch():
+            with tracing.span("fetch") as fetch_span:
+                source = load_source(
+                    image_src,
+                    options,
+                    self.params.by_key("tmp_dir", "var/tmp"),
+                    header_extra_options=self.params.by_key(
+                        "header_extra_options", ""
+                    ),
+                    policy=self.fetch_policy,
+                    deadline=deadline,
+                )
+                if fetch_span is not None:
+                    fetch_span.set_attribute("source.bytes", len(source.data))
+                    fetch_span.set_attribute("source.mime", source.info.mime)
+            return source
+
+        source = self._stage("fetch", _fetch, deadline, inline_fallback=False)
         timings["fetch"] = time.perf_counter() - t
         return source
 
@@ -830,6 +879,7 @@ class ImageHandler:
         frame: np.ndarray,
         frame_plan: TransformPlan,
         deadline: Optional[Deadline],
+        src_window: Optional[Tuple[int, int]] = None,
     ) -> np.ndarray:
         """Resolve one batched transform, degrading sanely when it can't:
         an exhausted budget is a 504 (fail fast, no further waiting); a
@@ -843,7 +893,7 @@ class ImageHandler:
                 deadline.check("device")
             if self.wedged_fallback:
                 self._record_wedge()
-                return run_plan(frame, frame_plan)
+                return run_plan(frame, frame_plan, src_window=src_window)
             exc = ServiceUnavailableException(
                 "device executor did not produce a result in time"
             )
@@ -1040,7 +1090,11 @@ class ImageHandler:
             # 400 on every jpg path, even though CMYK's 4-channel encode
             # does not subsample
             parse_sampling_factor(sampling_factor)
-            return _encode_cmyk_jpeg(frame, spec, quality, mozjpeg)
+            return self._stage(
+                "encode",
+                lambda: _encode_cmyk_jpeg(frame, spec, quality, mozjpeg),
+                deadline,
+            )
         if (
             self.codec_batcher is not None
             and spec.extension == "jpg"
@@ -1063,25 +1117,80 @@ class ImageHandler:
                 blob = None  # wedged codec pool: single-image encode below
             if blob is not None:
                 return blob
-        return encode(
-            frame,
-            spec.extension,
-            quality=quality,
-            webp_lossless=bool(options.truthy("webp-lossless")),
-            mozjpeg=mozjpeg,
-            sampling_factor=sampling_factor,
-            strip=options.truthy("strip"),
-            alpha=alpha,
+        # CPU-bound single-image encode: with the stage DAG on it runs
+        # on the bounded encode pool instead of oversubscribing request
+        # threads (the codec-batcher path above already bounds its own
+        # native parallelism)
+        return self._stage(
+            "encode",
+            lambda: encode(
+                frame,
+                spec.extension,
+                quality=quality,
+                webp_lossless=bool(options.truthy("webp-lossless")),
+                mozjpeg=mozjpeg,
+                sampling_factor=sampling_factor,
+                strip=options.truthy("strip"),
+                alpha=alpha,
+            ),
+            deadline,
         )
 
+    def _roi_window(self, options: OptionsBag, info, hint,
+                    is_animated_gif_out: bool):
+        """The post-prescale source window this request's plan lets the
+        decoder restrict itself to (spec/plan.py decode_roi_window), or
+        None for full decode. The probe plan is built against the dims
+        the prescaled decode WILL produce (libjpeg's ceil rule), with
+        metrics=None so the real build below does the filter-alias
+        counting exactly once (same discipline as rewrite_for_reuse)."""
+        if (
+            info.mime != "image/jpeg"
+            or not info.width
+            or not info.height
+            or is_animated_gif_out
+        ):
+            return None
+        from flyimg_tpu.codecs import jpeg_batch_scale_num
+
+        scale = jpeg_batch_scale_num(info, hint)
+        sw = (info.width * scale + 7) // 8
+        sh = (info.height * scale + 7) // 8
+        try:
+            probe_plan = build_plan(options, sw, sh)
+        except Exception:
+            # an invalid option raises identically in the real
+            # build_plan below — the probe must not pre-empt (or alter)
+            # that typed error path
+            return None
+        return decode_roi_window(probe_plan)
+
+    @staticmethod
+    def _decode_mode(decoded, info, hint) -> str:
+        """The decode-mode vocabulary (full | prescale | roi) stamped on
+        spans, the flyimg_decode_mode_total counter, and the per-mode
+        stage series the perf gate's schema-5 legs read."""
+        if decoded.roi_offset is not None:
+            return "roi"
+        if info.mime == "image/jpeg":
+            w0, h0 = decoded.orig_size
+            # EXIF orientation may have transposed the frame — only a
+            # dims change beyond the swap means the DCT prescale ran
+            if decoded.size not in ((w0, h0), (h0, w0)):
+                return "prescale"
+        return "full"
+
     def _decode_batched(self, data: bytes, hint, info,
-                        deadline: Optional[Deadline] = None):
+                        deadline: Optional[Deadline] = None,
+                        roi=None):
         """JPEG fast path through the native DecodePool: concurrent misses
         sharing a DCT prescale decode as ONE pool batch on the host-codec
-        controller's thread. Returns None for everything the pool doesn't
-        cover (non-JPEG, pool unavailable, a per-image decode failure, or
-        a wedged pool) — the caller falls back to the single-image
-        decode()."""
+        controller's thread. ``roi`` (a post-prescale ``(x0, y0, x1, y1)``
+        window, docs/host-pipeline.md) rides the same coalesced pool call
+        — mixed full/window members share one launch. Returns None for
+        everything the pool doesn't cover (non-JPEG, pool unavailable, a
+        per-image decode failure, or a wedged pool) — the caller falls
+        back to the single-image decode()."""
         if self.codec_batcher is None:
             return None
         from flyimg_tpu.codecs import (
@@ -1090,21 +1199,40 @@ class ImageHandler:
             jpeg_batch_scale_num,
         )
         from flyimg_tpu.codecs import native_codec
+        from flyimg_tpu.codecs.exif import jpeg_orientation
 
         if info.mime != "image/jpeg" or native_codec.get_pool() is None:
             return None
+        if roi is not None and jpeg_orientation(data) != 1:
+            # the window coordinates would not survive the EXIF
+            # transpose the full path applies — decode the full frame
+            roi = None
         scale = jpeg_batch_scale_num(info, hint)
         try:
-            rgb = self.codec_batcher.submit_aux(
-                ("jpegdec", scale), (data, scale), batch_jpeg_decode
+            result = self.codec_batcher.submit_aux(
+                ("jpegdec", scale), (data, scale, roi), batch_jpeg_decode
             ).result(timeout=self._device_wait_s(deadline))
         except FutureTimeout:
             if deadline is not None:
                 deadline.check("decode")
             self._record_wedge()
             return None
-        if rgb is None:
+        if result is None:
             return None
+        if isinstance(result, tuple):
+            window, offset, frame_size = result
+            return DecodedImage(
+                rgb=window,
+                alpha=None,
+                mime="image/jpeg",
+                orig_size=(
+                    info.width or frame_size[0],
+                    info.height or frame_size[1],
+                ),
+                roi_offset=offset,
+                frame_size=frame_size,
+            )
+        rgb = result
         return DecodedImage(
             rgb=rgb,
             alpha=None,
@@ -1153,16 +1281,39 @@ class ImageHandler:
         gif_frame = options.int_option("gif-frame", 0) or 0
         with tracing.span("decode") as decode_span:
             data_info = media_info(data)  # one probe, shared by both paths
-            decoded = self._decode_batched(data, hint, data_info, deadline)
+            # ROI decode (docs/host-pipeline.md): for crop/extract-
+            # dominant plans, decode only the source window the plan's
+            # resample actually samples (+ tap-support margin). The
+            # window is computed against the post-prescale frame the
+            # decode will produce, so ROI and the DCT prescale compose.
+            roi = (
+                self._roi_window(options, data_info, hint, is_animated_gif_out)
+                if self.decode_roi else None
+            )
+            decoded = self._decode_batched(
+                data, hint, data_info, deadline, roi=roi
+            )
             batched_decode = decoded is not None
             if decoded is None:
-                decoded = decode(
-                    data, target_hint=hint, frame=gif_frame, info=data_info
+                decoded = self._stage(
+                    "decode",
+                    lambda: decode(
+                        data, target_hint=hint, frame=gif_frame,
+                        info=data_info, roi=roi,
+                    ),
+                    deadline,
                 )
+            decode_mode = self._decode_mode(decoded, data_info, hint)
             if decode_span is not None:
                 decode_span.set_attribute("decode.mime", data_info.mime)
                 decode_span.set_attribute("decode.batched", batched_decode)
+                decode_span.set_attribute("decode.mode", decode_mode)
         timings["decode"] = time.perf_counter() - t
+        # the per-mode stage series feeds the perf-gate's decode-mode
+        # legs (tools/perf_gate.py schema 5) and bench_http's
+        # decode-split reporting without disturbing the aggregate
+        # `decode` stage every dashboard already reads
+        timings[f"decode_{decode_mode}"] = timings["decode"]
         if self.metrics is not None:
             # host-codec throughput accounting (the codec-overhaul
             # baseline, ROADMAP item 4): compressed bytes in, next to
@@ -1171,8 +1322,19 @@ class ImageHandler:
                 "flyimg_decode_bytes_total",
                 "Compressed source bytes through the host decode stage",
             ).inc(len(data))
+            self.metrics.counter(
+                f'flyimg_decode_mode_total{{mode="{decode_mode}"}}',
+                "Host decodes by mode (full | prescale | roi)",
+            ).inc()
 
         w, h = decoded.size
+        src_window = None
+        if decoded.roi_offset is not None and decoded.frame_size is not None:
+            # the decoded pixels are a window; geometry must still
+            # resolve against the FULL (post-prescale) frame dims, with
+            # the window offset threaded to the device as a span shift
+            w, h = decoded.frame_size
+            src_window = decoded.roi_offset
         plan = build_plan(options, w, h, metrics=self.metrics)
         if render_info is not None:
             render_info["plan"] = plan
@@ -1257,7 +1419,14 @@ class ImageHandler:
             staged = []
             for idx, frame in enumerate(frames):
                 fh, fw = frame.shape[:2]
-                if (fw, fh) == plan.src_size:
+                window = None
+                if src_window is not None and anim is None:
+                    # ROI decode: the frame IS a window of plan.src_size;
+                    # the plan stays as built against the full frame and
+                    # the offset shifts the traced spans downstream
+                    frame_plan = plan
+                    window = src_window
+                elif (fw, fh) == plan.src_size:
                     frame_plan = plan
                 else:
                     frame_plan = build_plan(
@@ -1277,9 +1446,12 @@ class ImageHandler:
                         unsharp=None, sharpen=None, blur=None,
                         background=(255, 255, 255),
                     )
-                tiled = self._tiled_or_none(frame, frame_plan)
+                tiled = (
+                    None if window is not None
+                    else self._tiled_or_none(frame, frame_plan)
+                )
                 if tiled is not None:
-                    staged.append((tiled, frame, frame_plan))
+                    staged.append((tiled, frame, frame_plan, None))
                 elif self.batcher is not None:
                     # concurrent requests sharing a program batch into one
                     # device launch; the deadline-aware wait below parks
@@ -1287,18 +1459,23 @@ class ImageHandler:
                     # (flyimg_tpu/runtime/batcher.py)
                     staged.append(
                         (
-                            self.batcher.submit(frame, frame_plan),
-                            frame, frame_plan,
+                            self.batcher.submit(
+                                frame, frame_plan, src_window=window
+                            ),
+                            frame, frame_plan, window,
                         )
                     )
                 else:
                     staged.append(
-                        (run_plan(frame, frame_plan), frame, frame_plan)
+                        (
+                            run_plan(frame, frame_plan, src_window=window),
+                            frame, frame_plan, None,
+                        )
                     )
             out_frames = [
-                self._await_transform(s, frame, frame_plan, deadline)
+                self._await_transform(s, frame, frame_plan, deadline, window)
                 if isinstance(s, Future) else s
-                for s, frame, frame_plan in staged
+                for s, frame, frame_plan, window in staged
             ]
         timings["device"] = time.perf_counter() - t
 

@@ -423,6 +423,133 @@ def rewrite_for_reuse(
     return reuse_plan, target_out, None
 
 
+# ---------------------------------------------------------------------------
+# ROI decode window (docs/host-pipeline.md "ROI window math")
+
+#: safety pixels added beyond the resample filter's tap radius when
+#: computing a decode window: absorbs the float span rounding AND the
+#: <=1 u8 chroma-upsampling difference a JPEG crop decode can show in its
+#: outermost columns (the affected pixels land inside the margin, outside
+#: the span any output pixel samples)
+ROI_TAP_MARGIN = 2
+
+#: a decode window is only worth restricting to when it covers at most
+#: this fraction of the frame's pixels: near-full windows still pay the
+#: entropy decode of (almost) every row, so the crop bookkeeping would
+#: cost more than the skipped IDCT saves
+ROI_MAX_FRAME_FRAC = 0.8
+
+
+def plan_source_window(
+    plan: TransformPlan,
+) -> Optional[Tuple[float, float, float, float]]:
+    """The float source rectangle ``(x0, y0, x1, y1)`` the plan's windowed
+    resample actually samples, or None when it spans the full frame.
+
+    Mirrors ``ops.compose.plan_layout``'s span fusion — extract is a
+    source pre-pass, and a pure extent-crop (offset inside the resized
+    image on both axes) fuses into the resample window — and is pinned
+    against plan_layout by test so the two cannot drift. Everything
+    downstream of the resample (color ops, rotate, convs, post passes)
+    consumes resample OUTPUT pixels and never widens the source window.
+    """
+    src_w, src_h = plan.src_size
+    if plan.extract is not None:
+        x0, y0, x1, y1 = plan.extract
+        base_x, base_y = float(x0), float(y0)
+        eff_w, eff_h = float(x1 - x0), float(y1 - y0)
+    else:
+        base_x = base_y = 0.0
+        eff_w, eff_h = float(src_w), float(src_h)
+    if plan.resize_to is not None:
+        rw, rh = plan.resize_to
+    else:
+        rw, rh = int(eff_w), int(eff_h)
+    if plan.extent is not None:
+        tw, th = plan.extent
+        off_x, off_y = gravity_offset(rw, rh, tw, th, plan.gravity)
+        if off_x >= 0 and off_y >= 0 and tw <= rw and th <= rh:
+            sx = eff_w / rw
+            sy = eff_h / rh
+            window = (
+                base_x + off_x * sx,
+                base_y + off_y * sy,
+                base_x + off_x * sx + tw * sx,
+                base_y + off_y * sy + th * sy,
+            )
+            return None if _is_full_frame(window, src_w, src_h) else window
+    window = (base_x, base_y, base_x + eff_w, base_y + eff_h)
+    return None if _is_full_frame(window, src_w, src_h) else window
+
+
+def _is_full_frame(window, src_w: int, src_h: int) -> bool:
+    x0, y0, x1, y1 = window
+    return x0 <= 0.0 and y0 <= 0.0 and x1 >= src_w and y1 >= src_h
+
+
+def _plan_window_out(plan: TransformPlan) -> Tuple[int, int]:
+    """Output (w, h) of the windowed resample — what the span maps onto
+    (extent for a fused pure crop, else resize target, else the window
+    itself); sets the tap-support scale in decode_roi_window."""
+    if plan.extent is not None:
+        rw, rh = plan.resize_to if plan.resize_to else plan.effective_src
+        tw, th = plan.extent
+        off_x, off_y = gravity_offset(rw, rh, tw, th, plan.gravity)
+        if off_x >= 0 and off_y >= 0 and tw <= rw and th <= rh:
+            return (tw, th)
+    if plan.resize_to is not None:
+        return plan.resize_to
+    return plan.effective_src
+
+
+def decode_roi_window(
+    plan: TransformPlan,
+    *,
+    max_frame_frac: float = ROI_MAX_FRAME_FRAC,
+) -> Optional[Tuple[int, int, int, int]]:
+    """The integer source window ``(x0, y0, x1, y1)`` a ROI-capable
+    decoder may restrict itself to for this plan, or None when the plan
+    consumes (nearly) the whole frame.
+
+    The window is the plan's sampled span (:func:`plan_source_window`)
+    expanded per axis by the resample filter's tap support radius in
+    SOURCE pixels — ``support * max(downscale_factor, 1)`` taps reach at
+    most that far beyond a sampled position — plus ``ROI_TAP_MARGIN``
+    slack, clamped to the frame. With that margin, a decode of only this
+    window followed by a span shift of the device resample produces
+    bit-identical samples to a full-frame decode: every tap an output
+    pixel reads lands inside the window, and at real frame edges the
+    window edge IS the frame edge so tap zeroing matches exactly.
+    """
+    window = plan_source_window(plan)
+    if window is None:
+        return None
+    src_w, src_h = plan.src_size
+    if src_w <= 0 or src_h <= 0:
+        return None
+    # lazy import: spec is a lower layer than ops (which imports jax);
+    # sharing ops.resample's FILTER_SUPPORT table keeps ONE source of
+    # truth for tap radii (the same table K-selection derives from)
+    from flyimg_tpu.ops.resample import FILTER_SUPPORT
+
+    support = FILTER_SUPPORT.get(plan.filter_method, 3.0)
+    x0, y0, x1, y1 = window
+    out_w, out_h = _plan_window_out(plan)
+    scale_x = (x1 - x0) / max(out_w, 1)
+    scale_y = (y1 - y0) / max(out_h, 1)
+    margin_x = math.ceil(support * max(scale_x, 1.0)) + ROI_TAP_MARGIN
+    margin_y = math.ceil(support * max(scale_y, 1.0)) + ROI_TAP_MARGIN
+    ix0 = max(int(math.floor(x0)) - margin_x, 0)
+    iy0 = max(int(math.floor(y0)) - margin_y, 0)
+    ix1 = min(int(math.ceil(x1)) + margin_x, src_w)
+    iy1 = min(int(math.ceil(y1)) + margin_y, src_h)
+    if ix1 <= ix0 or iy1 <= iy0:
+        return None
+    if (ix1 - ix0) * (iy1 - iy0) > max_frame_frac * src_w * src_h:
+        return None
+    return (ix0, iy0, ix1, iy1)
+
+
 def rotated_bounds(w: int, h: int, degrees: float) -> Tuple[int, int]:
     """Enclosing bounding box of a w x h image rotated by ``degrees``
     (IM RotateImage grows the canvas to the rotated bounding box; for
@@ -450,6 +577,14 @@ def decode_target_hint(options: OptionsBag) -> Optional[Tuple[int, int]]:
     scaling). Accounts for sc_N so an upscaling request never decodes below
     the final target — the decode must stay >= 2x the device resample's
     output for the resample to be quality-determining."""
+    if options.truthy("extract"):
+        # e_ coordinates are in ORIGINAL source pixels: a DCT-prescaled
+        # decode would shrink the frame underneath them and build_plan
+        # would clamp the box against the wrong dims — silently cropping
+        # a different region. Extract plans decode at full scale; the
+        # ROI window decode (decode_roi; docs/host-pipeline.md) is the
+        # optimization that serves them instead.
+        return None
     tw = _positive_or_none(options.int_option("width"))
     th = _positive_or_none(options.int_option("height"))
     if not (tw or th):

@@ -184,7 +184,7 @@ def test_gate_cost_self_test_injected_flop_regression_fails(tmp_path):
     update = run("--update")
     assert update.returncode == 0, update.stderr
     doc = json.loads(baseline.read_text())
-    assert doc["schema"] == 4
+    assert doc["schema"] == 5
     assert set(doc["kernels"]) == {"dense", "banded"}
     for kern in ("dense", "banded"):
         cost = doc["kernels"][kern]["plan_cost"]

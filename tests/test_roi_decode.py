@@ -1,0 +1,369 @@
+"""ROI JPEG decode (docs/host-pipeline.md): window math, native/PIL
+decode parity, end-to-end serving parity across the crop/extract/gravity
+matrix, pool abort safety, and the off-is-off byte-identity pin."""
+
+import io
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from flyimg_tpu.codecs import decode, native_codec, pil_codec
+from flyimg_tpu.ops.compose import plan_layout, run_plan
+from flyimg_tpu.spec.options import OptionsBag
+from flyimg_tpu.spec.plan import (
+    build_plan,
+    decode_roi_window,
+    decode_target_hint,
+    plan_source_window,
+)
+
+
+def _smooth(w: int, h: int, seed: int = 11) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    base = rng.integers(0, 255, (48, 64, 3), dtype=np.uint8)
+    return np.asarray(Image.fromarray(base).resize((w, h), Image.BILINEAR))
+
+
+def _jpeg(arr: np.ndarray, quality: int = 92) -> bytes:
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, "JPEG", quality=quality)
+    return buf.getvalue()
+
+
+SRC_W, SRC_H = 1600, 1200
+SRC = _smooth(SRC_W, SRC_H)
+SRC_JPEG = _jpeg(SRC)
+
+
+def make_handler(root, **overrides):
+    """A direct (batcher-less) handler rooted at ``root`` — the shared
+    factory of this file and tests/test_host_pipeline.py. ``overrides``
+    merge into the params (decode_roi, host_pipeline_enable, ...); a
+    HostPipeline is wired whenever the knob asks for one."""
+    from flyimg_tpu.appconfig import AppParameters
+    from flyimg_tpu.runtime.hostpipeline import HostPipeline
+    from flyimg_tpu.service.handler import ImageHandler
+    from flyimg_tpu.storage import make_storage
+
+    os.makedirs(root, exist_ok=True)
+    params = AppParameters({
+        "upload_dir": os.path.join(str(root), "uploads"),
+        "tmp_dir": os.path.join(str(root), "tmp"),
+        **overrides,
+    })
+    pipeline = HostPipeline.from_params(params)
+    handler = ImageHandler(
+        make_storage(params), params, host_pipeline=pipeline
+    )
+    return handler, pipeline
+
+# the crop/extract/gravity option matrix the parity pins sweep: every row
+# yields a sub-frame window (decode_roi_window not None) at 1600x1200
+ROI_MATRIX = [
+    "w_200,h_300,c_1",                                  # portrait crop, center
+    "w_200,h_300,c_1,g_NorthWest",
+    "w_200,h_300,c_1,g_SouthEast",                      # window at far edges
+    "w_300,h_100,c_1,g_West",
+    "w_100,h_200,c_1,g_South",
+    "e_1,p1x_200,p1y_100,p2x_900,p2y_700,w_200",        # extract + resize
+    "e_1,p1x_0,p1y_0,p2x_400,p2y_300",                  # extract at origin
+    "e_1,p1x_1200,p1y_800,p2x_1600,p2y_1200,w_100",     # extract at far corner
+    "e_1,p1x_100,p1y_100,p2x_700,p2y_500,w_150,r_90",   # window + rotate
+]
+
+
+# ---------------------------------------------------------------------------
+# window math (spec/plan.py)
+
+
+def test_plan_source_window_mirrors_plan_layout_spans():
+    """The spec-layer window math must agree with the compose layer's
+    span fusion — the two implementations must not drift."""
+    for opts in ROI_MATRIX + ["w_200", "w_300,h_225,c_1", "r_45"]:
+        plan = build_plan(OptionsBag(opts), SRC_W, SRC_H)
+        window = plan_source_window(plan)
+        layout = plan_layout(plan)
+        if window is None:
+            # full frame: the layout span must cover the whole source
+            assert layout.span_x == (0.0, float(SRC_W))
+            assert layout.span_y == (0.0, float(SRC_H))
+            continue
+        x0, y0, x1, y1 = window
+        assert x0 == pytest.approx(layout.span_x[0])
+        assert y0 == pytest.approx(layout.span_y[0])
+        assert x1 == pytest.approx(layout.span_x[0] + layout.span_x[1])
+        assert y1 == pytest.approx(layout.span_y[0] + layout.span_y[1])
+
+
+def test_decode_roi_window_contains_span_with_margin():
+    for opts in ROI_MATRIX:
+        plan = build_plan(OptionsBag(opts), SRC_W, SRC_H)
+        window = plan_source_window(plan)
+        roi = decode_roi_window(plan)
+        assert roi is not None, opts
+        x0, y0, x1, y1 = roi
+        sx0, sy0, sx1, sy1 = window
+        # integer window strictly contains the float span (or is clamped
+        # at a real frame edge, where span touches the edge too)
+        assert x0 <= sx0 and y0 <= sy0
+        assert x1 >= sx1 and y1 >= sy1
+        assert 0 <= x0 < x1 <= SRC_W
+        assert 0 <= y0 < y1 <= SRC_H
+
+
+def test_decode_roi_window_none_for_full_frame_plans():
+    for opts in ("w_200", "w_300,h_225,c_1", "r_45", "blur_3",
+                 "w_200,h_150,c_1"):
+        plan = build_plan(OptionsBag(opts), SRC_W, SRC_H)
+        assert decode_roi_window(plan) is None, opts
+
+
+def test_decode_roi_window_worth_it_gate():
+    """A window covering (nearly) the whole frame is not worth a crop
+    decode — the gate returns None above the area fraction."""
+    plan = build_plan(OptionsBag("e_1,p1x_0,p1y_0,p2x_1590,p2y_1190"),
+                      SRC_W, SRC_H)
+    assert decode_roi_window(plan) is None
+    # but an explicit wider gate admits it
+    assert decode_roi_window(plan, max_frame_frac=1.0) is not None
+
+
+def test_decode_target_hint_disabled_for_extract():
+    """e_ coordinates are in ORIGINAL pixels: the DCT prescale must not
+    shrink the frame underneath them (the pre-overhaul path clamped the
+    box against the prescaled dims — a different region)."""
+    assert decode_target_hint(OptionsBag("e_1,p1x_0,p1y_0,p2x_100,p2y_100,w_50")) is None
+    assert decode_target_hint(OptionsBag("w_200")) == (200, 200)
+
+
+def test_extract_on_jpeg_crops_true_source_region(tmp_path):
+    """End-to-end pin of the extract/prescale fix: an e_ box addressing
+    the far corner of a large JPEG must crop that region, byte-close to
+    the same request against a lossless PNG of the same pixels."""
+    handler, _ = make_handler(tmp_path)
+    jpeg_path = tmp_path / "src.jpg"
+    jpeg_path.write_bytes(SRC_JPEG)
+    png_path = tmp_path / "src.png"
+    Image.fromarray(SRC).save(png_path, "PNG")
+    opts = "e_1,p1x_1200,p1y_800,p2x_1600,p2y_1200,w_100,o_png"
+    out_jpegsrc = handler.process_image(opts, str(jpeg_path))
+    out_pngsrc = handler.process_image(opts, str(png_path))
+    a = np.asarray(Image.open(io.BytesIO(out_jpegsrc.content)).convert("RGB"))
+    b = np.asarray(Image.open(io.BytesIO(out_pngsrc.content)).convert("RGB"))
+    assert a.shape == b.shape
+    # same region, differing only by the source's JPEG quantization
+    assert np.abs(a.astype(int) - b.astype(int)).mean() < 3.0
+
+
+# ---------------------------------------------------------------------------
+# decode-level parity (codecs)
+
+
+needs_native_roi = pytest.mark.skipif(
+    not native_codec.roi_supported(),
+    reason="native fastcodec without libjpeg-turbo ROI support",
+)
+
+
+@needs_native_roi
+@pytest.mark.parametrize("scale_num", [8, 4, 2])
+def test_native_roi_decode_matches_full_decode_slice(scale_num):
+    full = native_codec.jpeg_decode(SRC_JPEG, scale_num)
+    fh, fw = full.shape[:2]
+    for req in [(100, 50, 300, 200), (0, 0, 64, 64),
+                (fw - 80, fh - 60, 80, 60), (33, 17, 131, 99)]:
+        got = native_codec.jpeg_decode_roi(SRC_JPEG, scale_num, req)
+        assert got is not None
+        win, (ox, oy), (gfw, gfh) = got
+        assert (gfw, gfh) == (fw, fh)
+        # actualized window contains the request (iMCU left-alignment)
+        assert ox <= req[0] and oy == req[1]
+        assert ox + win.shape[1] >= req[0] + req[2]
+        ref = full[oy:oy + win.shape[0], ox:ox + win.shape[1]]
+        diff = np.abs(win.astype(int) - ref.astype(int))
+        # the window INTERIOR is <= 1 u8 of the full decode; the 1-2
+        # boundary columns of a subsampled (4:2:0) source may differ
+        # more (fancy chroma upsampling lacks its neighbor there) —
+        # which is exactly why decode_roi_window's ROI_TAP_MARGIN keeps
+        # boundary columns outside the span any output pixel samples.
+        # A boundary column coinciding with the real frame edge has no
+        # missing neighbor, so no inset is needed there.
+        il = 2 if ox > 0 else 0
+        ir = 2 if ox + win.shape[1] < fw else 0
+        it = 2 if oy > 0 else 0
+        ib = 2 if oy + win.shape[0] < fh else 0
+        interior = diff[it:win.shape[0] - ib or None,
+                        il:win.shape[1] - ir or None]
+        assert interior.max() <= 1
+        assert diff.max() <= 16  # boundary columns stay bounded too
+
+
+@needs_native_roi
+def test_native_roi_window_clamped_at_image_edges():
+    win, (ox, oy), (fw, fh) = native_codec.jpeg_decode_roi(
+        SRC_JPEG, 8, (-50, -50, 10_000, 10_000)
+    )
+    assert (ox, oy) == (0, 0)
+    assert win.shape[:2] == (fh, fw) == (SRC_H, SRC_W)
+
+
+def test_pil_fallback_roi_matches_native_contract(monkeypatch):
+    monkeypatch.setattr(native_codec, "roi_supported", lambda: False)
+    from flyimg_tpu.codecs import media_info
+
+    info = media_info(SRC_JPEG)
+    decoded = decode(data=SRC_JPEG, info=info, roi=(100, 50, 400, 250))
+    assert decoded.roi_offset == (100, 50)
+    assert decoded.frame_size == (SRC_W, SRC_H)
+    assert decoded.rgb.shape == (200, 300, 3)
+    ref = pil_codec.decode(SRC_JPEG).rgb[50:250, 100:400]
+    assert np.array_equal(decoded.rgb, ref)
+
+
+def test_exif_rotated_jpeg_skips_roi():
+    buf = io.BytesIO()
+    exif = Image.Exif()
+    exif[274] = 6  # orientation: rotate 90 CW
+    Image.fromarray(_smooth(400, 300)).save(buf, "JPEG", exif=exif)
+    data = buf.getvalue()
+    decoded = decode(data=data, roi=(10, 10, 100, 100))
+    assert decoded.roi_offset is None  # full decode, oriented
+    assert decoded.size == (300, 400)  # transposed by orientation
+
+
+@needs_native_roi
+def test_pool_batch_mixed_roi_and_malformed_abort_safety():
+    """A truncated/garbage JPEG inside a pooled ROI batch nulls only its
+    own slot; the worker threads survive and the pool serves the next
+    batch — the error path must not leak or kill pool workers."""
+    pool = native_codec.DecodePool(2)
+    try:
+        full = native_codec.jpeg_decode(SRC_JPEG, 8)
+        for _ in range(2):  # twice: workers must survive round one
+            out = pool.decode_batch(
+                [SRC_JPEG, SRC_JPEG[:300], b"garbage" * 64, SRC_JPEG],
+                8,
+                rois=[None, (0, 0, 64, 64), (0, 0, 64, 64),
+                      (128, 64, 160, 96)],
+            )
+            assert isinstance(out[0], np.ndarray)
+            assert out[1] is None and out[2] is None
+            win, (ox, oy), (fw, fh) = out[3]
+            assert (fw, fh) == (SRC_W, SRC_H)
+            ref = full[oy:oy + win.shape[0], ox:ox + win.shape[1]]
+            diff = np.abs(win.astype(int) - ref.astype(int))
+            # interior parity; boundary columns carry the subsampled-
+            # chroma upsampling edge (absorbed by ROI_TAP_MARGIN)
+            assert diff[2:-2, 2:-2].max() <= 1
+            assert diff.max() <= 16
+    finally:
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end serving parity (handler)
+
+
+def _roi_handlers(tmp_path):
+    handler_off, _ = make_handler(tmp_path / "off")
+    handler_on, _ = make_handler(tmp_path / "on", decode_roi=True)
+    return handler_off, handler_on
+
+
+def test_end_to_end_roi_parity_matrix(tmp_path):
+    """decode_roi on vs off: <= 1 u8 on lossless outputs across the
+    crop/extract/gravity matrix (including ROI+prescale combined and
+    windows clamped at frame edges)."""
+    handler_off, handler_on = _roi_handlers(tmp_path)
+    src = tmp_path / "src.jpg"
+    src.write_bytes(SRC_JPEG)
+    for opts in ROI_MATRIX:
+        off = handler_off.process_image(f"{opts},o_png", str(src))
+        on = handler_on.process_image(f"{opts},o_png", str(src))
+        a = np.asarray(Image.open(io.BytesIO(off.content))).astype(int)
+        b = np.asarray(Image.open(io.BytesIO(on.content))).astype(int)
+        assert a.shape == b.shape, opts
+        assert np.abs(a - b).max() <= 1, opts
+        assert "decode_roi" in on.timings, opts
+        assert "decode_roi" not in off.timings, opts
+
+
+def test_roi_plus_prescale_combined(tmp_path):
+    """A crop-dominant plan whose w/h hint also engages the DCT prescale
+    must decode a window OF the prescaled frame — both optimizations
+    compose (the decoded window is smaller than the full scaled frame,
+    and parity holds)."""
+    handler_off, handler_on = _roi_handlers(tmp_path)
+    src = tmp_path / "src.jpg"
+    src.write_bytes(SRC_JPEG)
+    opts = "w_100,h_300,c_1,o_png"  # portrait crop of 4:3 -> narrow span
+    off = handler_off.process_image(opts, str(src))
+    on = handler_on.process_image(opts, str(src))
+    assert "decode_prescale" in off.timings  # hint engaged without ROI
+    assert "decode_roi" in on.timings        # ROI rode the scaled frame
+    a = np.asarray(Image.open(io.BytesIO(off.content))).astype(int)
+    b = np.asarray(Image.open(io.BytesIO(on.content))).astype(int)
+    assert np.abs(a - b).max() <= 1
+
+
+def test_full_frame_plan_ignores_roi_knob(tmp_path):
+    handler_off, handler_on = _roi_handlers(tmp_path)
+    src = tmp_path / "src.jpg"
+    src.write_bytes(SRC_JPEG)
+    on = handler_on.process_image("w_200,o_png", str(src))
+    off = handler_off.process_image("w_200,o_png", str(src))
+    assert "decode_roi" not in on.timings
+    assert on.content == off.content  # same full-frame path, same bytes
+
+
+def test_off_is_off_byte_identity(tmp_path):
+    """Both knobs at their defaults serve byte-for-byte what a handler
+    with no overhaul knobs serves — the default-compatible pin."""
+    baseline, _ = make_handler(tmp_path / "a")
+    explicit, _ = make_handler(
+        tmp_path / "b", decode_roi=False, host_pipeline_enable=False
+    )
+    src_a = tmp_path / "a-src.jpg"
+    src_a.write_bytes(SRC_JPEG)
+    src_b = tmp_path / "b-src.jpg"
+    src_b.write_bytes(SRC_JPEG)
+    for opts in ("w_200,h_300,c_1,o_jpg", "e_1,p1x_10,p1y_10,p2x_500,p2y_400,o_png"):
+        a = baseline.process_image(opts, str(src_a))
+        b = explicit.process_image(opts, str(src_b))
+        assert a.content == b.content
+
+
+def test_batcher_src_window_groups_with_full_members(tmp_path):
+    """ROI (windowed) and full-frame members coexist in the batcher:
+    each resolves to its own correct output (the window member's spans
+    are shifted per member, not per group)."""
+    from flyimg_tpu.runtime.batcher import BatchController
+
+    plan = build_plan(OptionsBag("w_200,h_300,c_1"), SRC_W, SRC_H)
+    roi = decode_roi_window(plan)
+    assert roi is not None
+    x0, y0, x1, y1 = roi
+    window = np.ascontiguousarray(SRC[y0:y1, x0:x1])
+    full_ref = run_plan(SRC, plan)
+    batcher = BatchController(max_batch=8, deadline_ms=20.0, lone_flush=False)
+    try:
+        futs = [
+            batcher.submit(window, plan, src_window=(x0, y0)),
+            batcher.submit(SRC, plan),
+        ]
+        outs = [f.result(timeout=60) for f in futs]
+    finally:
+        batcher.close()
+    assert np.abs(outs[1].astype(int) - full_ref.astype(int)).max() <= 1
+    assert np.abs(outs[0].astype(int) - full_ref.astype(int)).max() <= 1
+
+
+def test_src_window_validation():
+    plan = build_plan(OptionsBag("w_200,h_300,c_1"), SRC_W, SRC_H)
+    with pytest.raises(ValueError):
+        run_plan(SRC, plan, src_window=(10, 10))  # exceeds plan src
+    bare = build_plan(OptionsBag("blur_2"), 100, 80)
+    with pytest.raises(ValueError):
+        run_plan(np.zeros((40, 50, 3), np.uint8), bare, src_window=(0, 0))

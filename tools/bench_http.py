@@ -161,6 +161,12 @@ _KERNEL_TAG = None
 # setting produced each curve; None (no --reuse) omits the field
 _REUSE_TAG = None
 
+# host-codec-overhaul tags (--decode-roi / --pipeline): stamped into
+# every result row like _KERNEL_TAG so the thumbnail/cropzoom A/B
+# artifacts carry which knobs produced each curve (docs/host-pipeline.md)
+_ROI_TAG = None
+_PIPELINE_TAG = None
+
 
 def _zipf_weights(n: int, s: float = 1.1) -> list:
     """Zipf-ish popularity over ladder ranks: rank r gets 1/(r+1)^s.
@@ -240,6 +246,10 @@ def _report(name: str, mode: str, lat, failures: int, elapsed: float,
             row["kernel"] = _KERNEL_TAG
         if _REUSE_TAG is not None:
             row["reuse_enable"] = _REUSE_TAG == "on"
+        if _ROI_TAG is not None:
+            row["decode_roi"] = _ROI_TAG == "on"
+        if _PIPELINE_TAG is not None:
+            row["host_pipeline"] = _PIPELINE_TAG == "on"
         if extra:
             row.update(extra)
         print(f"{name:8s} {mode:6s}  ALL {failures} REQUESTS FAILED "
@@ -266,6 +276,10 @@ def _report(name: str, mode: str, lat, failures: int, elapsed: float,
         row["kernel"] = _KERNEL_TAG
     if _REUSE_TAG is not None:
         row["reuse_enable"] = _REUSE_TAG == "on"
+    if _ROI_TAG is not None:
+        row["decode_roi"] = _ROI_TAG == "on"
+    if _PIPELINE_TAG is not None:
+        row["host_pipeline"] = _PIPELINE_TAG == "on"
     if extra:
         row.update(extra)
     # extra may null throughput/success (the multisize split legs share
@@ -282,6 +296,42 @@ def _report(name: str, mode: str, lat, failures: int, elapsed: float,
     )
     print(json.dumps(row))
     return row
+
+
+def _make_source_4k(path: str, seed: int = 77) -> str:
+    """ONE smooth 4k JPEG (seeded noise upscaled bilinearly compresses
+    sanely and decodes realistically) — the source the thumbnail and
+    cropzoom mixes hammer."""
+    from PIL import Image
+
+    if not os.path.exists(path):
+        rng = np.random.default_rng(seed)
+        arr = rng.integers(0, 256, size=(135, 240, 3), dtype=np.uint8)
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        Image.fromarray(arr).resize(
+            (3840, 2160), Image.BILINEAR
+        ).save(path, "JPEG", quality=90)
+    return path
+
+
+async def _decode_split(client: httpx.AsyncClient, base: str):
+    """Decode-stage latency split by decode mode (full | prescale | roi)
+    from /debug/perf's stage quantiles — the headline figures of the
+    host-codec-overhaul A/B (docs/host-pipeline.md). None when the
+    target serves 404 (debug off)."""
+    try:
+        resp = await client.get(f"{base}/debug/perf")
+        if resp.status_code != 200:
+            return None
+        stages = resp.json().get("stages", {})
+    except (httpx.HTTPError, ValueError):
+        return None
+    return {
+        name: doc for name, doc in stages.items()
+        if name == "decode" or name.startswith("decode_")
+    } or None
 
 
 def _free_port() -> int:
@@ -382,29 +432,49 @@ async def main() -> int:
              "every result row. With --base it only stamps the rows — the "
              "target's own config decides what actually runs")
     ap.add_argument(
-        "--mix", default=None, choices=("multisize",),
+        "--mix", default=None,
+        choices=("multisize", "thumbnail", "cropzoom"),
         help="traffic-mix scenario: 'multisize' = ONE source requested "
              "at a Zipf-distributed ladder of crop sizes, every request "
              "a distinct uncached key — the derivative-reuse pattern "
              "(docs/caching.md). Reports ancestor-hit ratio and the "
-             "p50/p99 split between reuse=hit and reuse=miss rows")
+             "p50/p99 split between reuse=hit and reuse=miss rows. "
+             "'thumbnail' = ONE 4k source, a Zipf ladder of small "
+             "fit-resize outputs (the decode-dominated firehose); "
+             "'cropzoom' = overlapping extract windows on the 4k source "
+             "(pan/zoom traffic). Both report the decode-stage p50/p99 "
+             "split by decode mode (full | prescale | roi) scraped from "
+             "/debug/perf — the host-codec-overhaul A/B artifact "
+             "(docs/host-pipeline.md)")
     ap.add_argument(
         "--mix-requests", type=int, default=300,
-        help="requests in the --mix multisize leg")
+        help="requests in the --mix leg")
     ap.add_argument(
         "--reuse", default=None, choices=("on", "off"),
         help="derivative-reuse rewriter for the spawned service "
              "(reuse_enable; docs/caching.md), stamped into every result "
              "row as reuse_enable. With --base it only stamps the rows")
+    ap.add_argument(
+        "--decode-roi", default=None, choices=("on", "off"),
+        help="ROI JPEG decode for the spawned service (decode_roi; "
+             "docs/host-pipeline.md), stamped into every result row. "
+             "With --base it only stamps the rows")
+    ap.add_argument(
+        "--pipeline", default=None, choices=("on", "off"),
+        help="host stage DAG for the spawned service "
+             "(host_pipeline_enable; docs/host-pipeline.md), stamped "
+             "into every result row. With --base it only stamps the rows")
     args = ap.parse_args()
 
     if args.base and args.spawn:
         print("--base and --spawn are mutually exclusive", file=sys.stderr)
         return 2
 
-    global _KERNEL_TAG, _REUSE_TAG
+    global _KERNEL_TAG, _REUSE_TAG, _ROI_TAG, _PIPELINE_TAG
     _KERNEL_TAG = args.kernel
     _REUSE_TAG = args.reuse
+    _ROI_TAG = args.decode_roi
+    _PIPELINE_TAG = args.pipeline
 
     proc = None
     store = None
@@ -434,6 +504,16 @@ async def main() -> int:
             if args.reuse is not None:
                 fh.write(
                     f"reuse_enable: {'true' if args.reuse == 'on' else 'false'}\n"
+                )
+            if args.decode_roi is not None:
+                fh.write(
+                    "decode_roi: "
+                    f"{'true' if args.decode_roi == 'on' else 'false'}\n"
+                )
+            if args.pipeline is not None:
+                fh.write(
+                    "host_pipeline_enable: "
+                    f"{'true' if args.pipeline == 'on' else 'false'}\n"
                 )
             if store is not None:
                 fh.write(f"upload_dir: {os.path.join(store, 'out')}\n")
@@ -651,6 +731,94 @@ async def main() -> int:
                             },
                         )
                         all_rows.append(row)
+
+            if args.mix in ("thumbnail", "cropzoom"):
+                # host-codec-overhaul mixes (docs/host-pipeline.md): ONE
+                # 4k source; every request a distinct uncached key so the
+                # full miss pipeline runs. 'thumbnail' is a Zipf ladder
+                # of SQUARE crop thumbnails (crop-dominant on a 16:9
+                # frame: prescale + ROI both engage); 'cropzoom' is
+                # overlapping e_ extract windows at three zoom levels
+                # (pan/zoom traffic — full-scale decode, ROI-dominant).
+                src4k = _make_source_4k(
+                    os.path.join(
+                        os.path.dirname(args.source) or ".", "bench-4k.jpg"
+                    )
+                )
+                rng = np.random.default_rng(20260803)
+                urls = []
+                warm_urls = []
+                dropped_keyspace = 0
+                if args.mix == "thumbnail":
+                    ladder = [64, 96, 128, 160, 200, 256, 320, 400, 512]
+                    weights = _zipf_weights(len(ladder))
+                    counts = {size: 0 for size in ladder}
+                    warm_urls = [
+                        f"{base}/upload/w_{s},h_{s},c_1,q_90,o_jpg/{src4k}"
+                        for s in ladder
+                    ]
+                    for _ in range(args.mix_requests):
+                        size = int(rng.choice(ladder, p=weights))
+                        q = 89 - counts[size]
+                        if q < 2:
+                            # that size's quality-derived key space is
+                            # spent; COUNTED and stamped into the row —
+                            # a silently smaller request set would
+                            # misrepresent the measured mix
+                            dropped_keyspace += 1
+                            continue
+                        counts[size] += 1
+                        urls.append(
+                            f"{base}/upload/w_{size},h_{size},c_1,q_{q},"
+                            f"o_jpg/{src4k}"
+                        )
+                else:
+                    zooms = [(960, 540), (1280, 720), (1920, 1080)]
+                    warm_urls = [
+                        f"{base}/upload/e_1,p1x_0,p1y_0,p2x_{zw},p2y_{zh},"
+                        f"w_320,q_90,o_jpg/{src4k}"
+                        for zw, zh in zooms
+                    ]
+                    for i in range(args.mix_requests):
+                        zw, zh = zooms[i % len(zooms)]
+                        x = int(rng.integers(0, (3840 - zw) // 16 + 1)) * 16
+                        y = int(rng.integers(0, (2160 - zh) // 16 + 1)) * 16
+                        q = 88 - (i % 80)
+                        urls.append(
+                            f"{base}/upload/e_1,p1x_{x},p1y_{y},"
+                            f"p2x_{x + zw},p2y_{y + zh},w_320,q_{q},"
+                            f"o_jpg/{src4k}"
+                        )
+                if dropped_keyspace:
+                    print(
+                        f"{args.mix}: {dropped_keyspace} of "
+                        f"{args.mix_requests} requests dropped (Zipf-top "
+                        "rung key space spent) — raise the ladder or "
+                        "lower --mix-requests",
+                        file=sys.stderr,
+                    )
+                # warm pass compiles the ladder's program shapes
+                # off-record (one request per distinct geometry)
+                await _miss_run(client, warm_urls, min(args.conc, 4))
+                lat, fails, elapsed = await _miss_run(
+                    client, urls, args.conc
+                )
+                split = await _decode_split(client, base)
+                extra = {"decode_stages": split}
+                if dropped_keyspace:
+                    extra["requests_dropped_keyspace"] = dropped_keyspace
+                all_rows.append(
+                    _report(
+                        args.mix, "miss", lat, fails, elapsed,
+                        extra=extra,
+                    )
+                )
+                if split:
+                    for mode, doc in sorted(split.items()):
+                        print(
+                            f"  {mode:16s} n={doc['count']:<5} "
+                            f"p50={doc['p50_ms']}ms p99={doc['p99_ms']}ms"
+                        )
 
             # end-of-run attribution: batch efficiency + per-plan cost +
             # flight-recorder summary embedded in every row (and the

@@ -53,6 +53,16 @@ distinct targets from a seeded pure ancestor, and the measured
 cannot silently regress the reuse path. Pre-schema-4 baselines report
 the row as ``missing`` without failing.
 
+**Schema 5** splits the decode stage by decode mode: dedicated legs
+measure ``decode_full`` (the PNG micro-suite's full-frame decode),
+``decode_prescale`` (a JPEG source whose small target engages the DCT
+prescale), and ``decode_roi`` (a crop-dominant plan on a handler with
+``decode_roi`` on — the ROI window decode, docs/host-pipeline.md), each
+gated like any other stage so a codec change cannot silently regress one
+decode mode while another hides it. A pre-5 baseline's ``decode`` row
+stands in for ``decode_full`` (the then-only mode measured); its missing
+prescale/roi rows report ``missing`` without failing.
+
 CI: the ``perf-gate`` job runs ``--check`` with wide, CI-noise-tolerant
 bands (see .github/workflows/ci.yml). Baseline refresh policy:
 benchmarks/README.md.
@@ -74,7 +84,12 @@ sys.path.insert(0, REPO_ROOT)
 DEFAULT_BASELINE = os.path.join(
     REPO_ROOT, "benchmarks", "perf_baseline.json"
 )
-STAGES = ("decode", "device", "encode", "total", "cache_hit", "reuse_hit")
+STAGES = (
+    "decode", "device", "encode", "total", "cache_hit", "reuse_hit",
+    # per-decode-mode legs (schema 5): the handler stamps
+    # timings["decode_<mode>"] per miss (service/handler.py _decode_mode)
+    "decode_full", "decode_prescale", "decode_roi",
+)
 # per-plan cost figures gated alongside the latency stages (schema 2);
 # cost analysis is deterministic per jax version, so its band is tight
 COST_FIELDS = ("flops_total", "bytes_total")
@@ -82,7 +97,7 @@ COST_FIELDS = ("flops_total", "bytes_total")
 # stages on shared runners jitter by fractions of a ms that no relative
 # band should be asked to absorb
 ABS_SLACK_MS = 2.0
-SCHEMA = 4
+SCHEMA = 5
 # the resample-kernel variants each baseline carries a column for
 # (ops/resample.py KERNEL_MODES minus 'auto', which resolves to one of
 # these per geometry and would gate nothing new)
@@ -215,7 +230,10 @@ def measure(repeats: int = 30, warmup: int = 3,
             run_miss(f"warm-{i}")
         for i in range(repeats):
             timings = run_miss(f"run-{i}")
-            for stage in ("decode", "device", "encode", "total"):
+            # decode_full rides the main suite: the PNG source decodes
+            # full-frame, so its per-mode stamp IS the full-mode figure
+            for stage in ("decode", "decode_full", "device", "encode",
+                          "total"):
                 rows[stage].append(timings[stage])
 
         # cache-hit path: populate once, then time pure hits through the
@@ -260,6 +278,56 @@ def measure(repeats: int = 30, warmup: int = 3,
             )
             assert result.reused_from, "perf-gate reuse leg missed"
             rows["reuse_hit"].append(result.timings["reuse_hit"])
+
+        # decode-mode legs (schema 5; docs/host-pipeline.md): a JPEG
+        # source big enough that w_64 engages the 1/8 DCT prescale, and
+        # an extract-dominant plan on a decode_roi handler engages the
+        # ROI window decode. Timed but (like the reuse leg) outside the
+        # plan-cost snapshot — their latency columns are the gate.
+        jpeg_arr = rng.integers(0, 255, (768, 1024, 3), dtype=np.uint8)
+        jpeg_data = encode(jpeg_arr, "jpg", quality=85, mozjpeg=False)
+        params_roi = AppParameters({
+            "tmp_dir": os.path.join(tmp, "dt"),
+            "upload_dir": os.path.join(tmp, "du"),
+            "batch_deadline_ms": 0.5,
+            "decode_roi": True,
+        })
+        handler_roi = ImageHandler(
+            LocalStorage(params_roi), params_roi, batcher=batcher
+        )
+        decode_legs = (
+            ("decode_prescale", handler, "w_64,h_48,o_png"),
+            (
+                "decode_roi", handler_roi,
+                "e_1,p1x_256,p1y_128,p2x_640,p2y_512,w_64,o_png",
+            ),
+        )
+        for stage_name, leg_handler, leg_options in decode_legs:
+            for i in range(max(warmup, 1)):
+                leg_timings: dict = {}
+                leg_handler.transform_bytes(
+                    jpeg_data, OptionsBag(leg_options),
+                    OutputSpec(
+                        name=f"gate-{stage_name}-warm-{i}.png",
+                        extension="png", mime=EXT_TO_MIME["png"],
+                    ),
+                    leg_timings,
+                )
+                assert stage_name in leg_timings, (
+                    f"perf-gate {stage_name} leg did not engage its "
+                    f"decode mode (got {sorted(leg_timings)})"
+                )
+            for i in range(repeats):
+                leg_timings = {}
+                leg_handler.transform_bytes(
+                    jpeg_data, OptionsBag(leg_options),
+                    OutputSpec(
+                        name=f"gate-{stage_name}-{i}.png",
+                        extension="png", mime=EXT_TO_MIME["png"],
+                    ),
+                    leg_timings,
+                )
+                rows[stage_name].append(leg_timings[stage_name])
     finally:
         if injector is not None:
             faults.clear()
@@ -379,6 +447,16 @@ def compare(baseline: dict, current: dict, tolerance: float,
         for stage in STAGES:
             base = base_stages.get(stage, {}).get("median_ms")
             cur = cur_stages.get(stage, {}).get("median_ms")
+            if base is None and cur is not None and stage == "decode_full":
+                # pre-schema-5 baselines measured exactly one decode
+                # mode — their `decode` row reads as `full` (the
+                # prescale/roi legs stay `missing`, non-failing)
+                base = base_stages.get("decode", {}).get("median_ms")
+            if base is None and cur is None:
+                # neither side measured this stage (e.g. schema-4 docs
+                # compared against each other never ran the decode-mode
+                # legs): nothing to say, not even "missing"
+                continue
             if base is None or cur is None:
                 rows.append({
                     "kernel": kernel, "stage": stage, "verdict": "missing",

@@ -1,0 +1,189 @@
+"""CI host-codec-overhaul smoke: boot the app with ROI decode + the
+pipelined stage DAG enabled and prove the assembled loop end to end
+(docs/host-pipeline.md):
+
+- a crop-heavy render on a JPEG source decodes through the ROI window
+  path — its decode span carries ``decode.mode = "roi"`` and
+  ``flyimg_decode_mode_total{mode="roi"}`` increments,
+- the stage-pool surface is live: ``flyimg_host_pool_queue_depth{pool=}``
+  gauges for fetch/decode/encode are in /metrics and /debug/perf carries
+  the per-pool ``host_pipeline`` snapshot,
+- wire parity: the knobs-on bytes decode within 1 u8 of the same request
+  served by a knobs-off app (lossless output),
+- the knobs-off app is clean: no ROI decode mode, no pool gauges.
+
+    JAX_PLATFORMS=cpu python tools/smoke_host_pipeline.py
+
+Exit code 0 = every assertion held. The behavioral matrix (window math,
+decode parity, backpressure, wedge healing, drain) lives in
+tests/test_roi_decode.py + tests/test_host_pipeline.py; this script
+proves the assembled service — handler, stage pools, tracing, metrics,
+debug surface — runs the overhaul as one system.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import io
+import json
+import os
+import sys
+import tempfile
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def _require(cond: bool, what: str) -> None:
+    if not cond:
+        print(f"FAIL: {what}", file=sys.stderr)
+        raise SystemExit(1)
+
+
+def _metric_value(text: str, name: str) -> float:
+    for line in text.splitlines():
+        if line.startswith(name + " "):
+            try:
+                return float(line.rsplit(" ", 1)[1])
+            except ValueError:
+                continue
+    return float("nan")
+
+
+def _find_span(node: dict, name: str):
+    if node.get("name") == name:
+        return node
+    for child in node.get("children", ()):
+        found = _find_span(child, name)
+        if found is not None:
+            return found
+    return None
+
+
+async def main() -> int:
+    import numpy as np
+    from PIL import Image
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from flyimg_tpu.appconfig import AppParameters
+    from flyimg_tpu.service.app import make_app
+
+    tmp = tempfile.mkdtemp(prefix="flyimg-hostpipe-smoke-")
+    # a large smooth JPEG so the crop-heavy plan's window is a small
+    # fraction of the frame (ROI engages) and prescale has room to act
+    rng = np.random.default_rng(42)
+    base = rng.integers(0, 255, (48, 64, 3), dtype=np.uint8)
+    rgb = np.asarray(Image.fromarray(base).resize((1920, 1440)))
+    src = os.path.join(tmp, "src.jpg")
+    Image.fromarray(rgb).save(src, "JPEG", quality=92)
+
+    def params(sub: str, enabled: bool) -> AppParameters:
+        return AppParameters({
+            "tmp_dir": os.path.join(tmp, sub, "t"),
+            "upload_dir": os.path.join(tmp, sub, "u"),
+            "debug": True,
+            "decode_roi": enabled,
+            "host_pipeline_enable": enabled,
+        })
+
+    app_on = make_app(params("on", True))
+    app_off = make_app(params("off", False))
+    on = TestClient(TestServer(app_on))
+    off = TestClient(TestServer(app_off))
+    await on.start_server()
+    await off.start_server()
+    try:
+        target = "w_200,h_300,c_1,o_png"  # crop-dominant on 4:3 -> ROI
+
+        # 1) crop-heavy render decodes through the ROI window path
+        resp = await on.get(f"/upload/{target}/{src}")
+        _require(resp.status == 200, f"knobs-on render 200 ({resp.status})")
+        traceparent = resp.headers.get("traceparent", "")
+        trace_id = traceparent.split("-")[1] if "-" in traceparent else ""
+        _require(bool(trace_id), "knobs-on response carries a traceparent")
+        tree = json.loads(
+            await (await on.get(f"/debug/traces/{trace_id}")).text()
+        )
+        decode_span = None
+        for root in tree["spans"]:
+            decode_span = decode_span or _find_span(root, "decode")
+        _require(decode_span is not None, "decode span on the trace")
+        mode = (decode_span.get("attributes") or {}).get("decode.mode")
+        _require(
+            mode == "roi",
+            f"decode span tagged decode.mode=roi (got {mode!r})",
+        )
+
+        # 2) metrics surface: decode-mode counter + pool gauges
+        metrics_text = await (await on.get("/metrics")).text()
+        _require(
+            _metric_value(
+                metrics_text, 'flyimg_decode_mode_total{mode="roi"}'
+            ) >= 1.0,
+            "flyimg_decode_mode_total{mode=roi} incremented",
+        )
+        for pool in ("fetch", "decode", "encode"):
+            gauge = f'flyimg_host_pool_queue_depth{{pool="{pool}"}}'
+            _require(
+                gauge + " " in metrics_text,
+                f"{gauge} present in /metrics",
+            )
+
+        # 3) /debug/perf carries the stage-pool snapshot
+        perf = json.loads(await (await on.get("/debug/perf")).text())
+        _require(
+            isinstance(perf.get("host_pipeline"), dict)
+            and set(perf["host_pipeline"]) == {"fetch", "decode", "encode"},
+            f"host_pipeline snapshot in /debug/perf "
+            f"(got {perf.get('host_pipeline')!r})",
+        )
+        _require(
+            "decode_roi" in perf.get("stages", {}),
+            f"decode_roi stage series in /debug/perf "
+            f"(stages {sorted(perf.get('stages', {}))})",
+        )
+
+        # 4) wire parity vs the knobs-off app (lossless output)
+        base_resp = await off.get(f"/upload/{target}/{src}")
+        _require(
+            base_resp.status == 200,
+            f"knobs-off render 200 ({base_resp.status})",
+        )
+        got = np.asarray(
+            Image.open(io.BytesIO(await resp.read()))
+        ).astype(int)
+        want = np.asarray(
+            Image.open(io.BytesIO(await base_resp.read()))
+        ).astype(int)
+        _require(got.shape == want.shape, "on/off output dims agree")
+        diff = int(np.abs(got - want).max())
+        _require(diff <= 1, f"wire parity within 1 u8 (max {diff})")
+
+        # 5) the knobs-off app is clean
+        off_metrics = await (await off.get("/metrics")).text()
+        _require(
+            'flyimg_decode_mode_total{mode="roi"}' not in off_metrics,
+            "no ROI decodes on the knobs-off app",
+        )
+        _require(
+            "flyimg_host_pool_queue_depth" not in off_metrics,
+            "no stage-pool gauges on the knobs-off app",
+        )
+        off_perf = json.loads(await (await off.get("/debug/perf")).text())
+        _require(
+            off_perf.get("host_pipeline") is None,
+            "null host_pipeline snapshot with the DAG off",
+        )
+
+        print(
+            "host-pipeline smoke OK: ROI-tagged decode span, pool gauges "
+            f"live, wire parity max diff {diff} u8, knobs-off app clean"
+        )
+        return 0
+    finally:
+        await on.close()
+        await off.close()
+
+
+if __name__ == "__main__":
+    raise SystemExit(asyncio.run(main()))
