@@ -53,16 +53,19 @@ SERVER_DEFAULTS: Dict[str, Any] = {
     "decode_batch_max": 32,
     "decode_deadline_ms": 1.0,
     # --- host codec overhaul (docs/host-pipeline.md). Both knobs default
-    # OFF: serving is byte-identical to the pre-overhaul behavior
-    # (pinned by tests/test_roi_decode.py + tests/test_host_pipeline.py) ---
+    # ON since the recorded CPU soak A/B (benchmarks/HOSTPIPE_r02_soak.json:
+    # cropzoom 4.2x rps / p50 3030->696 ms, thumbnail p99 2008->928 ms,
+    # zero failures); explicit false restores the pre-overhaul inline
+    # path byte-for-byte (pinned by tests/test_roi_decode.py +
+    # tests/test_host_pipeline.py) ---
     # ROI JPEG decode: crop/extract-dominant plans decode only the source
     # window they consume (libjpeg-turbo crop/skip scanlines, composable
     # with the DCT prescale; PIL decode+crop fallback)
-    "decode_roi": False,
+    "decode_roi": True,
     # pipelined stage DAG (runtime/hostpipeline.py): bounded per-stage
     # worker pools for the miss path's host work, with admission-gate
     # backpressure instead of silent queueing
-    "host_pipeline_enable": False,
+    "host_pipeline_enable": True,
     "host_pipeline_fetch_workers": 4,
     "host_pipeline_decode_workers": 2,
     "host_pipeline_encode_workers": 2,
@@ -272,6 +275,46 @@ SERVER_DEFAULTS: Dict[str, Any] = {
     "reuse_index_max_sources": 512,
     "reuse_index_max_variants": 16,
     "reuse_index_ttl_s": 3600.0,
+    # --- fleet serving tier (runtime/fleet.py + storage/tiered.py;
+    # docs/fleet.md). EVERYTHING here defaults off: with fleet_replicas
+    # empty and l2_enable false the serving path is byte-for-byte the
+    # single-replica behavior — no routing, no shared tier, no lease
+    # markers, no new headers (pinned by tests/test_fleet.py) ---
+    # static replica set (base URLs, e.g. ["http://10.0.0.1:8080", ...]);
+    # non-empty arms rendezvous (HRW) owner routing of derived cache keys
+    "fleet_replicas": [],
+    # THIS replica's own entry in fleet_replicas (its identity in
+    # routing, lease markers, log lines, span attributes, and the
+    # debug-gated X-Flyimg-Replica header)
+    "fleet_replica_id": "",
+    # what a non-owner does with an owned key: 'proxy' forwards the
+    # request to the owner replica (batches stay dense per plan);
+    # 'local' renders here and write-through to the shared L2 makes the
+    # result fleet-visible anyway
+    "fleet_route": "proxy",
+    # ceiling on one proxied request's wait (also bounded by the request
+    # deadline); transport failure or expiry falls back to a local render
+    "fleet_proxy_timeout_s": 30.0,
+    # --- shared L2 cache tier (storage/tiered.py; docs/fleet.md) ---
+    # promote the output store to L1 (per-replica, storage_system) + L2
+    # (fleet-shared) with read-through promotion and write-through
+    "l2_enable": False,
+    # the shared tier's backend: 'local' (a shared mount at
+    # l2_upload_dir) or 's3'/'gcs' (same aws_s3/gcs config dicts)
+    "l2_storage_system": "local",
+    "l2_upload_dir": "web/l2",
+    # cross-replica single-flight over TTL'd lease markers in the L2:
+    # one replica renders a both-tier miss, the others poll for its
+    # artifact (bounded by the request deadline) instead of duplicating
+    "l2_lease_enable": True,
+    # lease expiry: a crashed leader's key becomes stealable after this
+    # long (set WELL above any sane render time — an expired-but-alive
+    # leader costs one duplicate render)
+    "l2_lease_ttl_s": 30.0,
+    # follower poll cadence while waiting on a leader's artifact
+    "l2_lease_poll_ms": 50.0,
+    # ceiling on one follower wait when no request deadline bounds it
+    "l2_lease_wait_cap_s": 120.0,
     # --- negative origin cache (runtime/brownout.py NegativeCache) ---
     # seconds a failing origin (retry-exhausted transient errors, open
     # breaker) short-circuits repeat fetches of the same host+path to an

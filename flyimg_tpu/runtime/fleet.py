@@ -1,0 +1,283 @@
+"""Fleet routing: rendezvous placement of derived cache keys
+(docs/fleet.md; ROADMAP item 2).
+
+"Millions of users" means N replicas behind a load balancer, and a
+round-robin balancer sprays the same derived key — and the same compiled
+program's traffic — across all of them: every replica misses, fetches,
+and renders the hot key, and every replica's batch controller sees a
+thin slice of every plan instead of a dense stream of a few. This
+module is the placement half of the TensorFlow-style dataflow split
+(arXiv 1605.08695): the **decision** of which replica owns a key is
+separated from the **execution** (the existing single-process pipeline,
+untouched), so same-key traffic concentrates and same-plan batches stay
+dense (the affinity half measured by ``bench_http --replicas``).
+
+Routing is rendezvous hashing (HRW) over a **static replica set** (the
+``fleet_replicas`` knob): every replica scores ``hash(replica | key)``
+for each replica and the max wins — no coordination, no ring state, and
+removing one replica re-homes ONLY that replica's keys (the classic HRW
+minimal-disruption property, pinned by test). A non-owner either
+**proxies** the request to the owner (``fleet_route=proxy`` — one
+internal HTTP hop, marked with ``X-Flyimg-Fleet-Hop`` so config skew can
+never loop) or renders **locally** (``fleet_route=local``) and lets the
+shared-L2 write-through make the result fleet-visible.
+
+Owner-down fallback rides the existing resilience machinery: one
+``CircuitBreaker`` per owner URL (a dead owner sheds the proxy attempt
+in microseconds after the breaker trips) and the shared ``RetryPolicy``
+for transient transport errors — every failure path degrades to a local
+render, never a user-visible error the single-replica tier would not
+have produced.
+
+Inert by default: with ``fleet_replicas`` empty ``FleetRouter.enabled``
+is False and service/app.py never consults it (byte-identical off
+behavior pinned by tests/test_fleet.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, List, Optional, Tuple
+
+from flyimg_tpu.runtime.resilience import BreakerRegistry, RetryPolicy
+
+__all__ = ["FleetRouter", "HOP_HEADER", "route_key", "rendezvous_owner"]
+
+#: marks a request already forwarded once: the receiving replica always
+#: renders locally, so replica-set config skew cannot proxy in circles
+HOP_HEADER = "X-Flyimg-Fleet-Hop"
+
+#: response headers a proxied reply carries back to the client; everything
+#: hop-by-hop or recomputed by the local server is dropped
+_FORWARD_RESPONSE_HEADERS = (
+    "Content-Type",
+    "Cache-Control",
+    "Expires",
+    "Last-Modified",
+    "ETag",
+    "Warning",
+    "traceparent",
+    "X-Flyimg-Degraded",
+    "X-Flyimg-Reuse",
+    "X-Flyimg-Replica",
+    "Server-Timing",
+)
+
+
+#: option short-keys that change ONLY the encode step, never the device
+#: plan (docs/url-options.md): requests differing only in these share a
+#: compiled program, so routing them to one owner is what concentrates
+#: same-plan traffic into dense batches (the affinity half of the fleet
+#: tier). rf_ is a cache directive, not an identity component.
+_ENCODE_ONLY_KEYS = frozenset(
+    {"q", "moz", "sf", "st", "webpl", "rf"}
+)
+
+
+def route_key(options: str, image_src: str, separator: str = ",") -> str:
+    """The routing key for one request: a digest of the source plus the
+    PLAN-AFFINITY projection of the raw options segment — every option
+    token except the encode-only ones (quality, mozjpeg, sampling
+    factor, strip, lossless, refresh), order-normalized.
+
+    Deliberately computed from the URL alone, BEFORE any option parsing
+    or source probing (both may need the origin), so every replica
+    derives the identical key with no coordination. The projection is
+    strictly coarser than the derived cache key, so one derived output
+    always routes to one owner — and all the quality/encoding variants
+    of one geometry land on the SAME owner, whose batch controller then
+    sees a dense stream of one program instead of a thin slice of all of
+    them (measured by ``bench_http --replicas``). Signed/encrypted
+    options fall back to the opaque string — stable routing, no
+    affinity grouping."""
+    tokens = sorted(
+        token
+        for token in options.split(separator)
+        if token.split("_", 1)[0] not in _ENCODE_ONLY_KEYS
+    )
+    return hashlib.md5(
+        f"{separator.join(tokens)}/{image_src}".encode(
+            "utf-8", "surrogatepass"
+        )
+    ).hexdigest()
+
+
+def rendezvous_owner(replicas: List[str], key: str) -> str:
+    """Highest-random-weight owner of ``key`` over ``replicas``: max of
+    ``blake2b(replica | key)``, ties broken by the replica string so the
+    choice is total. Every replica computes this identically with no
+    shared state."""
+    best = None
+    best_score = None
+    for replica in replicas:
+        score = hashlib.blake2b(
+            f"{replica}|{key}".encode("utf-8"), digest_size=8
+        ).digest()
+        if best_score is None or (score, replica) > (best_score, best):
+            best, best_score = replica, score
+    if best is None:
+        raise ValueError("rendezvous_owner needs a non-empty replica set")
+    return best
+
+
+class FleetRouter:
+    """Owner resolution + owner proxying for one replica."""
+
+    def __init__(
+        self,
+        replicas: List[str],
+        self_id: str,
+        *,
+        mode: str = "proxy",
+        proxy_timeout_s: float = 30.0,
+        breakers: Optional[BreakerRegistry] = None,
+        retry: Optional[RetryPolicy] = None,
+        metrics=None,
+    ) -> None:
+        self.replicas = [str(r).rstrip("/") for r in replicas if str(r)]
+        self.self_id = str(self_id or "").rstrip("/")
+        self.mode = mode if mode in ("proxy", "local") else "proxy"
+        self.proxy_timeout_s = float(proxy_timeout_s)
+        self.breakers = breakers or BreakerRegistry()
+        self.retry = retry
+        self.metrics = metrics
+        # lazy httpx.AsyncClient (proxy mode only); typed loose because
+        # httpx ships no stubs in this toolchain
+        self._client: Optional[Any] = None
+
+    @property
+    def enabled(self) -> bool:
+        return len(self.replicas) >= 2 and bool(self.self_id)
+
+    @property
+    def proxies(self) -> bool:
+        return self.enabled and self.mode == "proxy"
+
+    def owner(self, key: str) -> str:
+        return rendezvous_owner(self.replicas, key)
+
+    def is_owner(self, key: str) -> bool:
+        return self.owner(key) == self.self_id
+
+    def record(self, outcome: str) -> None:
+        """One routing decision; ``outcome`` is the fixed vocabulary
+        self | hop | proxied | fallback | local (docs/observability.md)."""
+        if self.metrics is None:
+            return
+        self.metrics.counter(
+            f'flyimg_fleet_routed_total{{outcome="{outcome}"}}',
+            "Fleet routing decisions by outcome",
+        ).inc()
+
+    # -- proxying ----------------------------------------------------------
+
+    async def _get_client(self):
+        if self._client is None:
+            import httpx
+
+            self._client = httpx.AsyncClient(
+                timeout=self.proxy_timeout_s,
+                limits=httpx.Limits(max_connections=64),
+            )
+        return self._client
+
+    async def aclose(self) -> None:
+        if self._client is not None:
+            await self._client.aclose()
+            self._client = None
+
+    async def proxy(
+        self,
+        owner: str,
+        path_qs: str,
+        request_headers,
+        *,
+        timeout_s: Optional[float] = None,
+        traceparent: Optional[str] = None,
+    ) -> Optional[Tuple[int, Dict[str, str], bytes]]:
+        """Forward one request to its owner replica. Returns ``(status,
+        headers, body)`` to relay, or None when the owner cannot serve
+        it — breaker open, transport failure, timeout, or an owner
+        502/503/504 — and the caller renders locally. Only
+        deterministic owner responses (2xx/3xx/4xx) relay: an
+        overloaded or dying owner must never become a user-visible
+        error the single-replica tier would not have produced, so its
+        5xx counts as a breaker failure AND the non-owner picks up the
+        render (which also sheds load off the drowning owner).
+
+        The whole affair — every attempt plus the full-jitter backoff
+        between them — is bounded by ONE budget (the request deadline
+        capped at ``fleet_proxy_timeout_s``), so retries can never
+        stack per-attempt timeouts past what the caller would wait."""
+        import asyncio
+        import time as _time
+
+        import httpx
+
+        breaker = self.breakers.for_host(owner)
+        try:
+            breaker.allow()
+        except Exception:
+            return None  # open breaker: shed the hop, render locally
+        headers = {HOP_HEADER: self.self_id or "1"}
+        for name in ("Accept", "traceparent", "If-None-Match",
+                     "If-Modified-Since", "User-Agent"):
+            value = request_headers.get(name)
+            if value:
+                headers[name] = value
+        if traceparent:
+            # OUR position in the trace, not the client's inbound header:
+            # the owner's span tree then hangs off this replica's
+            # fleet.route span instead of forking a sibling trace
+            headers["traceparent"] = traceparent
+        client = await self._get_client()
+        cap = self.proxy_timeout_s
+        if timeout_s is not None:
+            cap = min(cap, max(float(timeout_s), 0.001))
+        give_up_at = _time.monotonic() + cap
+        attempts = self.retry.max_attempts if self.retry is not None else 1
+        for attempt in range(max(attempts, 1)):
+            if attempt and self.retry is not None:
+                # the shared full-jitter backoff between attempts — the
+                # same decorrelation discipline as every other retried
+                # path (runtime/resilience.py RetryPolicy); a backoff
+                # that would overshoot the budget ends the affair now
+                delay = self.retry.backoff(attempt)
+                if _time.monotonic() + delay >= give_up_at:
+                    break
+                await asyncio.sleep(delay)
+            remaining = give_up_at - _time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                resp = await client.get(
+                    f"{owner}{path_qs}", headers=headers, timeout=remaining
+                )
+            except httpx.HTTPError:
+                continue  # transient transport error: one more try
+            if resp.status_code in (502, 503, 504):
+                breaker.record_failure()
+                return None  # sick owner: render locally instead
+            breaker.record_success()
+            out_headers = {
+                name: resp.headers[name]
+                for name in _FORWARD_RESPONSE_HEADERS
+                if name in resp.headers
+            }
+            return resp.status_code, out_headers, resp.content
+        breaker.record_failure()
+        return None
+
+    @classmethod
+    def from_params(cls, params, *, metrics=None) -> "FleetRouter":
+        return cls(
+            list(params.by_key("fleet_replicas", []) or []),
+            str(params.by_key("fleet_replica_id", "") or ""),
+            mode=str(params.by_key("fleet_route", "proxy")),
+            proxy_timeout_s=float(
+                params.by_key("fleet_proxy_timeout_s", 30.0)
+            ),
+            breakers=BreakerRegistry.from_params(params, metrics=metrics),
+            retry=RetryPolicy.from_params(params, metrics=metrics),
+            metrics=metrics,
+        )

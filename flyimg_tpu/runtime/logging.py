@@ -96,6 +96,16 @@ def configure_logging(params=None, *, stream=None) -> logging.Logger:
             logger.removeHandler(handler)
     handler = logging.StreamHandler(stream)
     handler._flyimg_managed = True
+    # fleet attribution (docs/fleet.md): with a replica identity
+    # configured, EVERY flyimg log line carries it — multi-replica log
+    # streams interleave in one aggregator, and a line that cannot name
+    # its replica cannot be joined to that replica's traces or bench rows
+    replica = (
+        str(params.by_key("fleet_replica_id", "") or "")
+        if params is not None else ""
+    )
+    if replica:
+        handler.addFilter(_ReplicaFilter(replica))
     if fmt == "json":
         handler.setFormatter(JsonFormatter())
     else:
@@ -110,6 +120,21 @@ def configure_logging(params=None, *, stream=None) -> logging.Logger:
     return logger
 
 
+class _ReplicaFilter(logging.Filter):
+    """Stamps ``replica`` onto every record through the managed handler
+    (a Filter rather than a formatter concern so the text format carries
+    it too via record attributes)."""
+
+    def __init__(self, replica: str) -> None:
+        super().__init__()
+        self._replica = replica
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        if not hasattr(record, "replica"):
+            record.replica = self._replica
+        return True
+
+
 def access_log(
     *,
     method: str,
@@ -122,6 +147,7 @@ def access_log(
     trace_id: Optional[str] = None,
     span_id: Optional[str] = None,
     user_agent: Optional[str] = None,
+    replica: Optional[str] = None,
 ) -> None:
     """One structured access-log line per request. ``trace_id``/``span_id``
     correlate the line with its trace in ``/debug/traces/{id}``."""
@@ -141,6 +167,8 @@ def access_log(
         extra["span_id"] = span_id
     if user_agent:
         extra["user_agent"] = user_agent
+    if replica:
+        extra["replica"] = replica
     level = logging.INFO
     if status >= 500:
         level = logging.ERROR

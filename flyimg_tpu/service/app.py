@@ -320,6 +320,14 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
             "brownout_escalation", context=info
         )
     )
+    # fleet routing tier (runtime/fleet.py; docs/fleet.md): rendezvous
+    # owner placement of derived cache keys over the static
+    # fleet_replicas set, with owner proxying in fleet_route=proxy.
+    # Inert (enabled False, never consulted) with fleet_replicas empty.
+    from flyimg_tpu.runtime.fleet import HOP_HEADER, FleetRouter, route_key
+
+    fleet = FleetRouter.from_params(params, metrics=metrics)
+    replica_id = str(params.by_key("fleet_replica_id", "") or "")
     # pipelined host stage DAG (runtime/hostpipeline.py;
     # docs/host-pipeline.md): bounded fetch/decode/encode worker pools
     # with admission-gate backpressure. Inert (no pools, no gauges, no
@@ -436,6 +444,11 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
                 trace.root.set_attribute("http.path", request.path)
                 if request.remote:
                     trace.root.set_attribute("net.peer", request.remote)
+                if replica_id:
+                    # fleet attribution (docs/fleet.md): which replica's
+                    # ring this trace lives in — the join key between
+                    # multi-replica bench rows, log lines, and traces
+                    trace.root.set_attribute("fleet.replica_id", replica_id)
                 request["flyimg.trace"] = trace
         inflight.inc()
         t0 = time.perf_counter()
@@ -457,6 +470,18 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
                 # record BEFORE tracer.finish so a breach's span event
                 # rides the triggering trace into the ring
                 slo.record(duration, ok=status < 500, trace=trace)
+            if (
+                debug_enabled
+                and replica_id
+                and route in _TRACED_ROUTES
+                and response is not None
+                and "X-Flyimg-Replica" not in response.headers
+            ):
+                # debug-only replica attribution on every response this
+                # replica actually produced; a PROXIED response keeps the
+                # rendering owner's header (docs/fleet.md), so bench rows
+                # attribute latency to the replica that did the work
+                response.headers["X-Flyimg-Replica"] = replica_id
             if trace is not None:
                 trace.root.set_attribute("http.status", status)
                 tracer.finish(
@@ -493,6 +518,7 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
                         trace.root.span_id if trace is not None else None
                     ),
                     user_agent=request.headers.get("User-Agent"),
+                    replica=replica_id or None,
                 )
 
     app = web.Application(
@@ -519,6 +545,7 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
 
     async def _close_batcher(_app):
         draining["flag"] = True  # direct-cleanup callers flip it too
+        await fleet.aclose()
         batcher.close(drain_timeout_s)
         codec_batcher.close(drain_timeout_s)
         host_pipeline.close(drain_timeout_s)
@@ -599,7 +626,77 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
     async def index(_request: web.Request) -> web.Response:
         return web.Response(text=HOMEPAGE, content_type="text/html")
 
+    async def _route_fleet(request: web.Request) -> Optional[web.Response]:
+        """Owner routing for one /upload request (runtime/fleet.py;
+        docs/fleet.md). Returns the proxied owner response, or None when
+        THIS replica should render: it owns the key, the request already
+        hopped once, the mode is ``local``, or the owner is down (breaker
+        open / transport failure — the local render is the fallback, and
+        the shared-L2 lease still dedups the work fleet-wide)."""
+        if not fleet.enabled:
+            return None
+        key = route_key(
+            request.match_info["options"], request.match_info["imageSrc"],
+            separator=str(params.by_key("options_separator", ",")),
+        )
+        owner = fleet.owner(key)
+        trace = request.get("flyimg.trace")
+        # direct start_span/end rather than the ambient tracing.span
+        # context manager: this coroutine awaits mid-span, and ambient
+        # state is thread-local — another request's coroutine on this
+        # loop thread would inherit our span across the await
+        route_span = (
+            trace.start_span("fleet.route") if trace is not None else None
+        )
+        outcome = "self"
+        try:
+            if HOP_HEADER in request.headers:
+                # already forwarded once: render here regardless of what
+                # our (possibly skewed) replica set says — no proxy loops
+                outcome = "hop"
+                return None
+            if owner == fleet.self_id:
+                return None
+            if not fleet.proxies:
+                # fleet_route=local: render here; the L2 write-through
+                # makes the result every replica's cache hit anyway
+                outcome = "local"
+                return None
+            deadline_cap = (
+                float(params.by_key("request_deadline_s", 0.0) or 0.0)
+                or None
+            )
+            relayed = await fleet.proxy(
+                owner, request.path_qs, request.headers,
+                timeout_s=deadline_cap,
+                traceparent=(
+                    tracing.format_traceparent(
+                        trace.trace_id, route_span.span_id
+                    )
+                    if trace is not None and route_span is not None
+                    else None
+                ),
+            )
+            if relayed is None:
+                outcome = "fallback"
+                return None
+            outcome = "proxied"
+            status, headers, body = relayed
+            return web.Response(status=status, body=body, headers=headers)
+        finally:
+            fleet.record(outcome)
+            if route_span is not None:
+                route_span.attributes.update({
+                    "fleet.owner": owner,
+                    "fleet.self": fleet.self_id,
+                    "fleet.outcome": outcome,
+                })
+                route_span.end()
+
     async def upload(request: web.Request) -> web.Response:
+        routed = await _route_fleet(request)
+        if routed is not None:
+            return routed
         try:
             result = await _process(request)
         except AppException as exc:
@@ -804,6 +901,18 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
         # when on — the same document the bench harness scrapes
         doc["host_pipeline"] = (
             host_pipeline.snapshot() if host_pipeline.enabled else None
+        )
+        # fleet identity (docs/fleet.md): which replica produced these
+        # batch-efficiency windows — bench_http --replicas joins the
+        # per-replica occupancy/compile-miss deltas on this. Null when
+        # the fleet tier is off.
+        doc["fleet"] = (
+            {
+                "replica_id": replica_id,
+                "replicas": fleet.replicas,
+                "mode": fleet.mode,
+            }
+            if fleet.enabled else None
         )
         return web.Response(
             text=_json.dumps(doc),

@@ -199,16 +199,41 @@ class ImageHandler:
         self.reuse_degraded_min_scale = float(
             params.by_key("reuse_degraded_min_scale", 1.3)
         )
-        self.variants = VariantIndex.from_params(params, storage=storage)
+        # the index lives on the SHARED storage tier (docs/fleet.md):
+        # with the L2 on, manifests written by any replica are read by
+        # every replica's cold lookup — cross-replica derivative reuse.
+        # Single-tier storage is its own shared tier (same behavior as
+        # before the fleet tier existed). Storage-less callers (the bulk
+        # runner) get a memory-only index, as before.
+        self.variants = VariantIndex.from_params(
+            params, storage=storage.shared if storage is not None else None
+        )
         # ROI JPEG decode (docs/host-pipeline.md): crop/extract-dominant
         # plans decode only the source window they consume (decode_roi
-        # knob; default off = byte-identical full decodes, pinned by
+        # knob; explicit off = byte-identical full decodes, pinned by
         # tests/test_roi_decode.py)
         self.decode_roi = bool(params.by_key("decode_roi", False))
         # pipelined stage DAG (runtime/hostpipeline.py): bounded
         # per-stage pools for fetch/decode/encode host work. None or
         # disabled = today's inline stages exactly.
         self.host_pipeline = host_pipeline
+        # cross-replica single-flight (storage/tiered.py L2Lease;
+        # docs/fleet.md): on a both-tier miss the first replica leases
+        # the key in the shared L2 and renders; the rest poll for its
+        # artifact instead of duplicating the pipeline. None (the
+        # default — l2_enable off) keeps the miss path exactly today's.
+        self.fleet_replica_id = str(
+            params.by_key("fleet_replica_id", "") or ""
+        )
+        self.l2lease = None
+        if storage is not None and bool(
+            params.by_key("l2_enable", False)
+        ) and bool(params.by_key("l2_lease_enable", True)):
+            from flyimg_tpu.storage.tiered import L2Lease
+
+            self.l2lease = L2Lease.from_params(
+                params, storage=storage.shared
+            )
 
     def _stage(self, name: str, fn, deadline: Optional[Deadline],
                *, inline_fallback: bool = True):
@@ -460,7 +485,37 @@ class ImageHandler:
                 modified_at=modified_at, degraded=degraded,
             )
 
+        lease_token: Optional[str] = None
         try:
+            # cross-replica single-flight (docs/fleet.md): on a both-tier
+            # miss, lease the key in the shared L2 — the fleet leader
+            # renders below; a follower serves the leader's artifact here
+            # (no fetch, no decode, no device work) and settles its own
+            # local coalesced waiters with the same bytes. rf_1 refreshes
+            # skip the wait (they must re-render) but still write through,
+            # so the fleet converges on the refreshed bytes.
+            if self.l2lease is not None and not refresh:
+                verdict = self._l2_coalesce(spec, deadline)
+                if verdict[0] == "serve":
+                    _, remote_content, remote_mtime = verdict
+                    self._singleflight.done(
+                        spec.name, result=(remote_content, remote_mtime, ())
+                    )
+                    timings["l2_coalesced"] = time.perf_counter() - t0
+                    timings["total"] = timings["l2_coalesced"]
+                    if self.metrics is not None:
+                        # served without running a pipeline, like the
+                        # process-local coalesced path above
+                        self.metrics.record_cache(hit=True)
+                        self.metrics.record_stage(
+                            "l2_coalesced", timings["l2_coalesced"]
+                        )
+                    return ProcessedImage(
+                        content=remote_content, spec=spec, options=options,
+                        from_cache=True, timings=timings,
+                        modified_at=remote_mtime,
+                    )
+                lease_token = verdict[1]
             # BROWNOUT+ plan degradation: finishing ops dropped, device
             # smart-crop swapped for the host entropy crop, encode
             # quality clamped (docs/degradation.md). modes stays empty
@@ -533,8 +588,19 @@ class ImageHandler:
                         ancestor=reused,
                     )
         except BaseException as exc:
+            if lease_token is not None:
+                # release BEFORE settling local waiters: polling replicas
+                # steal a freed lease immediately instead of waiting out
+                # the TTL behind a leader that just failed
+                self.l2lease.release(spec.name, lease_token)
             self._singleflight.done(spec.name, exc=exc)
             raise
+        if lease_token is not None:
+            # the artifact write (when one happened) preceded this, so a
+            # follower that sees the freed lease finds the bytes; after a
+            # degraded (never-cached) render it finds nothing and renders
+            # itself — correct, just not coalesced
+            self.l2lease.release(spec.name, lease_token)
         self._singleflight.done(
             spec.name, result=(content, modified_at, tuple(modes))
         )
@@ -851,6 +917,111 @@ class ImageHandler:
                 stored_at=time.time(),
             ),
         )
+
+    # ------------------------------------------------------------------
+    # cross-replica single-flight (storage/tiered.py L2Lease;
+    # docs/fleet.md "The lease protocol")
+
+    def _l2_coalesce(self, spec: OutputSpec, deadline: Optional[Deadline]):
+        """Decide this replica's role for a both-tier miss. Returns
+        ``("lead", token)`` when this replica must render (``token``
+        releases the lease afterwards; None when lease IO itself failed
+        and we render uncoalesced), or ``("serve", content, mtime)``
+        with a remote leader's artifact.
+
+        Followers poll with the configured cadence, bounded by the
+        request Deadline (exhaustion -> 504, never a hang) and by the
+        lease wait cap (-> 503, like a wedged local leader). A lease
+        that expires or is released without an artifact — crashed
+        leader, degraded never-cached render — is stolen and this
+        replica renders. A torn artifact under an active lease is
+        sniffed, discarded from BOTH tiers, and re-rendered once the
+        lease frees (the read-time integrity posture of
+        ``_cache_entry_valid``, fleet-wide)."""
+        lease = self.l2lease
+        with tracing.span("l2.lease", key=spec.name) as lease_span:
+            token = lease.acquire(spec.name)
+            if token is not None:
+                # won the lease — but close the write-then-release race
+                # first: a previous leader may have published the
+                # artifact after our tiered fetch missed and before its
+                # release let our acquire through
+                cached = self.storage.fetch_hedged(spec.name)
+                if cached is not None and _cache_entry_valid(
+                    cached[0], spec
+                ):
+                    lease.release(spec.name, token)
+                    self._record_lease("coalesced")
+                    if lease_span is not None:
+                        lease_span.set_attribute("lease.role", "coalesced")
+                    return ("serve", cached[0], cached[1].mtime)
+                self._record_lease("lead")
+                tracing.add_event("l2.lease_acquired", key=spec.name)
+                if lease_span is not None:
+                    lease_span.set_attribute("lease.role", "leader")
+                return ("lead", token)
+            tracing.add_event(
+                "l2.lease_wait", key=spec.name,
+                holder=lease.holder(spec.name) or "",
+            )
+            waited = 0.0
+            while True:
+                if deadline is not None:
+                    deadline.check("l2_lease")
+                if waited >= lease.wait_cap_s:
+                    self._record_lease("timeout")
+                    if lease_span is not None:
+                        lease_span.set_attribute("lease.role", "timeout")
+                    raise ServiceUnavailableException(
+                        "timed out waiting for the fleet leader rendering "
+                        "this output"
+                    )
+                step = lease.poll_s
+                if deadline is not None:
+                    step = deadline.timeout(step) or step
+                lease._sleep(max(step, 0.001))
+                waited += max(step, 0.001)
+                cached = self.storage.fetch_hedged(spec.name)
+                if cached is not None:
+                    if _cache_entry_valid(cached[0], spec):
+                        self._record_lease("coalesced")
+                        if lease_span is not None:
+                            lease_span.set_attribute(
+                                "lease.role", "coalesced"
+                            )
+                        return ("serve", cached[0], cached[1].mtime)
+                    # torn under an active lease: a valid-magic,
+                    # garbage-body blob must not serve anywhere in the
+                    # fleet — discard both copies and re-render here
+                    # once the lease frees
+                    tracing.add_event(
+                        "cache.corrupt", key=spec.name,
+                        bytes=len(cached[0]),
+                    )
+                    if self.metrics is not None:
+                        self.metrics.record_cache_corrupt()
+                    try:
+                        self.storage.delete(spec.name)
+                    except Exception:
+                        pass
+                token = lease.acquire(spec.name)
+                if token is not None:
+                    self._record_lease("steal")
+                    tracing.add_event("l2.lease_steal", key=spec.name)
+                    if lease_span is not None:
+                        lease_span.set_attribute("lease.role", "steal")
+                    return ("lead", token)
+
+    def _record_lease(self, outcome: str) -> None:
+        """One cross-replica lease decision; ``outcome`` is the fixed
+        vocabulary lead | coalesced | steal | timeout
+        (docs/observability.md)."""
+        if self.metrics is None:
+            return
+        self.metrics.counter(
+            f'flyimg_l2_lease_total{{outcome="{outcome}"}}',
+            "Cross-replica lease decisions on both-tier cache misses",
+        ).inc()
 
     # ------------------------------------------------------------------
     # deadline-aware device waits

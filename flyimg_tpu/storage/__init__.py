@@ -10,24 +10,54 @@ from flyimg_tpu.storage.base import Storage  # noqa: F401
 from flyimg_tpu.storage.local import LocalStorage  # noqa: F401
 
 
-def make_storage(params, metrics=None) -> "Storage":
-    """Select the backend by the ``storage_system`` server param
-    (reference app.php:54-62) and arm its transient-failure retry policy
-    (runtime/resilience.py; knobs shared with source fetching)."""
-    from flyimg_tpu.runtime.resilience import RetryPolicy
-
-    system = params.by_key("storage_system", "local")
+def _make_backend(system: str, params) -> "Storage":
+    """One tier's backend by system name (local | s3 | gcs)."""
     if system == "s3":
         from flyimg_tpu.storage.s3 import S3Storage
 
-        storage: Storage = S3Storage(params)
-    elif system == "gcs":
+        return S3Storage(params)
+    if system == "gcs":
         from flyimg_tpu.storage.gcs import GCSStorage
 
-        storage = GCSStorage(params)
-    else:
-        storage = LocalStorage(params)
-    storage.retry_policy = RetryPolicy.from_params(params, metrics=metrics)
+        return GCSStorage(params)
+    return LocalStorage(params)
+
+
+def make_storage(params, metrics=None) -> "Storage":
+    """Select the backend by the ``storage_system`` server param
+    (reference app.php:54-62) and arm its transient-failure retry policy
+    (runtime/resilience.py; knobs shared with source fetching).
+
+    With ``l2_enable`` on, the selected backend becomes the per-replica
+    L1 of a ``TieredStorage`` over a fleet-shared L2
+    (``l2_storage_system`` — a local shared mount at ``l2_upload_dir``,
+    or the same S3/GCS config the single-tier backends read). Default
+    off: the plain single-tier storage, byte-identical to today
+    (docs/fleet.md; pinned by tests/test_fleet.py)."""
+    from flyimg_tpu.runtime.resilience import RetryPolicy
+
+    retry = RetryPolicy.from_params(params, metrics=metrics)
+    storage = _make_backend(
+        str(params.by_key("storage_system", "local")), params
+    )
+    storage.retry_policy = retry
+    if bool(params.by_key("l2_enable", False)):
+        from flyimg_tpu.appconfig import AppParameters
+        from flyimg_tpu.storage.tiered import TieredStorage
+
+        l2_params = AppParameters({
+            **params.as_dict(),
+            # the local-dir L2 roots at its own (shared-mount) path; the
+            # S3/GCS L2 backends read the same aws_s3/gcs config dicts
+            "upload_dir": str(params.by_key("l2_upload_dir", "web/l2")),
+        })
+        l2 = _make_backend(
+            str(params.by_key("l2_storage_system", "local")), l2_params
+        )
+        l2.retry_policy = retry
+        l2.metrics = metrics
+        storage.metrics = metrics
+        storage = TieredStorage(storage, l2, metrics=metrics)
     # hedged cache-hit reads (storage/base.py fetch_hedged): after this
     # many ms without a primary result, one backup read fires and the
     # winner serves — bounds the cache-hit tail when the store stalls.

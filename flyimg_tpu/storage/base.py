@@ -107,6 +107,14 @@ class Storage(abc.ABC):
         nothing (safe for unknown backends)."""
         return False
 
+    @property
+    def shared(self) -> "Storage":
+        """The tier shared across replicas — where fleet-visible state
+        (variant manifests, lease markers) must live. A plain single-tier
+        backend IS its own shared tier; ``storage.tiered.TieredStorage``
+        overrides this to return the L2 (docs/fleet.md)."""
+        return self
+
     def _with_retry(self, op: str, fn):
         """Run one storage operation under the retry policy (when set) and
         the ``storage.<op>`` fault-injection point. Injected plans may

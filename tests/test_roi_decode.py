@@ -51,6 +51,12 @@ def make_handler(root, **overrides):
     params = AppParameters({
         "upload_dir": os.path.join(str(root), "uploads"),
         "tmp_dir": os.path.join(str(root), "tmp"),
+        # both overhaul knobs DEFAULT ON since the HOSTPIPE_r02 soak
+        # (appconfig.SERVER_DEFAULTS); these files are A/B parity suites,
+        # so the factory pins the historical OFF state unless a test
+        # opts a knob back on explicitly
+        "decode_roi": False,
+        "host_pipeline_enable": False,
         **overrides,
     })
     pipeline = HostPipeline.from_params(params)
@@ -318,21 +324,41 @@ def test_full_frame_plan_ignores_roi_knob(tmp_path):
     assert on.content == off.content  # same full-frame path, same bytes
 
 
-def test_off_is_off_byte_identity(tmp_path):
-    """Both knobs at their defaults serve byte-for-byte what a handler
-    with no overhaul knobs serves — the default-compatible pin."""
-    baseline, _ = make_handler(tmp_path / "a")
-    explicit, _ = make_handler(
-        tmp_path / "b", decode_roi=False, host_pipeline_enable=False
+def test_defaults_are_on_and_explicit_off_restores_inline_path(tmp_path):
+    """The HOSTPIPE_r02 soak flipped both overhaul knobs to ON: bare
+    SERVER_DEFAULTS must engage ROI decode AND the stage DAG, and an
+    explicit false must restore the historical inline full/prescale
+    path (whose byte behavior the parity matrix above pins)."""
+    from flyimg_tpu.appconfig import AppParameters, SERVER_DEFAULTS
+    from flyimg_tpu.runtime.hostpipeline import HostPipeline
+    from flyimg_tpu.service.handler import ImageHandler
+    from flyimg_tpu.storage import make_storage
+
+    assert SERVER_DEFAULTS["decode_roi"] is True
+    assert SERVER_DEFAULTS["host_pipeline_enable"] is True
+    params = AppParameters({
+        "upload_dir": os.path.join(str(tmp_path), "def", "uploads"),
+        "tmp_dir": os.path.join(str(tmp_path), "def", "tmp"),
+    })
+    pipeline = HostPipeline.from_params(params)
+    handler = ImageHandler(
+        make_storage(params), params, host_pipeline=pipeline
     )
-    src_a = tmp_path / "a-src.jpg"
-    src_a.write_bytes(SRC_JPEG)
-    src_b = tmp_path / "b-src.jpg"
-    src_b.write_bytes(SRC_JPEG)
-    for opts in ("w_200,h_300,c_1,o_jpg", "e_1,p1x_10,p1y_10,p2x_500,p2y_400,o_png"):
-        a = baseline.process_image(opts, str(src_a))
-        b = explicit.process_image(opts, str(src_b))
-        assert a.content == b.content
+    src = tmp_path / "src.jpg"
+    src.write_bytes(SRC_JPEG)
+    try:
+        assert handler.decode_roi
+        assert pipeline.enabled
+        result = handler.process_image("w_200,h_300,c_1,o_png", str(src))
+        assert "decode_roi" in result.timings  # ROI engaged by default
+    finally:
+        pipeline.close()
+    off, _ = make_handler(tmp_path / "off")  # factory pins both OFF
+    src_off = tmp_path / "off-src.jpg"
+    src_off.write_bytes(SRC_JPEG)
+    result_off = off.process_image("w_200,h_300,c_1,o_png", str(src_off))
+    assert "decode_roi" not in result_off.timings
+    assert not off.decode_roi
 
 
 def test_batcher_src_window_groups_with_full_members(tmp_path):

@@ -1,0 +1,363 @@
+"""Two-tier storage + the cross-replica lease protocol
+(flyimg_tpu/storage/tiered.py; docs/fleet.md): read-through promotion,
+write-through, both-tier deletes, the shared-tier contract behind
+cross-replica variant manifests, and the L2Lease acquire / confirm /
+steal / release state machine — including the write-race and
+crashed-leader edges the fleet tier's dedup guarantees rest on."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from flyimg_tpu.appconfig import AppParameters
+from flyimg_tpu.runtime.metrics import MetricsRegistry
+from flyimg_tpu.storage import make_storage
+from flyimg_tpu.storage.base import Storage, StorageStat
+from flyimg_tpu.storage.local import LocalStorage
+from flyimg_tpu.storage.tiered import L2Lease, TieredStorage, lease_name
+
+
+def _local(root) -> LocalStorage:
+    return LocalStorage(AppParameters({"upload_dir": str(root)}))
+
+
+def _tiered(tmp_path, metrics=None):
+    l1 = _local(tmp_path / "l1")
+    l2 = _local(tmp_path / "l2")
+    return TieredStorage(l1, l2, metrics=metrics), l1, l2
+
+
+def _counter(metrics, name):
+    counter = metrics._counters.get(name)
+    return counter.value if counter is not None else 0.0
+
+
+# ---------------------------------------------------------------------------
+# TieredStorage
+
+
+def test_fetch_l1_hit_serves_without_l2(tmp_path):
+    tiered, l1, l2 = _tiered(tmp_path)
+    l1.write("a.png", b"l1-bytes")
+    l2.write("a.png", b"l2-bytes")
+    data, stat = tiered.fetch("a.png")
+    assert data == b"l1-bytes"
+    assert stat.mtime is not None
+
+
+def test_fetch_l2_hit_promotes_into_l1(tmp_path):
+    metrics = MetricsRegistry()
+    tiered, l1, l2 = _tiered(tmp_path, metrics=metrics)
+    l2.write("a.png", b"shared-bytes")
+    assert not l1.has("a.png")
+    data, _ = tiered.fetch("a.png")
+    assert data == b"shared-bytes"
+    # promoted: the next hit on this replica is local
+    assert l1.read("a.png") == b"shared-bytes"
+    assert _counter(metrics, "flyimg_l2_promotions_total") == 1.0
+
+
+def test_fetch_both_tier_miss_is_none(tmp_path):
+    tiered, _, _ = _tiered(tmp_path)
+    assert tiered.fetch("missing.png") is None
+
+
+def test_write_goes_through_both_tiers(tmp_path):
+    tiered, l1, l2 = _tiered(tmp_path)
+    mtime = tiered.write("a.png", b"bytes")
+    assert mtime is not None
+    assert l1.read("a.png") == b"bytes"
+    assert l2.read("a.png") == b"bytes"
+
+
+def test_write_l2_failure_degrades_to_l1_only(tmp_path):
+    class BrokenWrite(LocalStorage):
+        def write(self, name, data):
+            raise OSError("bucket down")
+
+    metrics = MetricsRegistry()
+    l1 = _local(tmp_path / "l1")
+    l2 = BrokenWrite(AppParameters({"upload_dir": str(tmp_path / "l2")}))
+    tiered = TieredStorage(l1, l2, metrics=metrics)
+    mtime = tiered.write("a.png", b"bytes")  # must not raise
+    assert mtime is not None
+    assert l1.read("a.png") == b"bytes"
+    assert (
+        _counter(metrics, "flyimg_l2_writethrough_failures_total") == 1.0
+    )
+
+
+def test_delete_removes_both_copies(tmp_path):
+    tiered, l1, l2 = _tiered(tmp_path)
+    tiered.write("a.png", b"bytes")
+    tiered.delete("a.png")
+    assert not l1.has("a.png")
+    assert not l2.has("a.png")
+    # idempotent when absent, like the single-tier contract
+    tiered.delete("a.png")
+
+
+def test_read_prefers_l1_and_never_promotes(tmp_path):
+    """read() serves mutable shared state (manifests): promoting an L2
+    read into L1 would freeze this replica on a stale copy the moment
+    another replica updates the L2 — so read() must fall through WITHOUT
+    writing back."""
+    tiered, l1, l2 = _tiered(tmp_path)
+    l2.write("m.variants.json", b"{}")
+    assert tiered.read("m.variants.json") == b"{}"
+    assert not l1.has("m.variants.json")
+
+
+def test_stat_and_has_fall_through(tmp_path):
+    tiered, _, l2 = _tiered(tmp_path)
+    assert not tiered.has("a.png")
+    assert tiered.stat("a.png") is None
+    l2.write("a.png", b"x")
+    assert tiered.has("a.png")
+    assert tiered.stat("a.png") is not None
+
+
+def test_shared_tier_contract(tmp_path):
+    """TieredStorage.shared is the L2 (cross-replica state lives there);
+    a plain backend is its OWN shared tier — callers never branch."""
+    tiered, _, l2 = _tiered(tmp_path)
+    assert tiered.shared is l2
+    plain = _local(tmp_path / "plain")
+    assert plain.shared is plain
+
+
+def test_prune_delegates_to_l1_and_reports_absence(tmp_path):
+    tiered, l1, _ = _tiered(tmp_path)
+    tiered.write("a.png", b"x" * 100)
+    assert hasattr(tiered, "prune")
+    summary = tiered.prune(10)
+    assert summary["deleted"] == 1
+    assert not l1.has("a.png")
+
+    class NoPrune(Storage):
+        def has(self, name):
+            return False
+
+        def read(self, name):
+            raise FileNotFoundError(name)
+
+        def write(self, name, data):
+            return None
+
+        def delete(self, name):
+            pass
+
+        def public_url(self, name, request_base=None):
+            return name
+
+    no_prune = TieredStorage(NoPrune(), _local(tmp_path / "x"))
+    assert not hasattr(no_prune, "prune")
+
+
+def test_make_storage_tiered_wiring(tmp_path):
+    on = make_storage(AppParameters({
+        "upload_dir": str(tmp_path / "l1"),
+        "l2_enable": True,
+        "l2_upload_dir": str(tmp_path / "shared"),
+    }))
+    assert isinstance(on, TieredStorage)
+    on.write("a.png", b"x")
+    assert (tmp_path / "shared" / "a.png").exists()
+    off = make_storage(AppParameters({"upload_dir": str(tmp_path / "solo")}))
+    assert isinstance(off, LocalStorage)
+    assert off.shared is off
+
+
+def test_tiered_hedged_fetch_path(tmp_path):
+    """fetch_hedged with hedging off IS the tiered fetch — the handler's
+    one-round-trip cache check works unchanged over two tiers."""
+    tiered, _, l2 = _tiered(tmp_path)
+    l2.write("a.png", b"bytes")
+    data, stat = tiered.fetch_hedged("a.png")
+    assert data == b"bytes"
+    assert isinstance(stat, StorageStat)
+
+
+# ---------------------------------------------------------------------------
+# L2Lease
+
+
+def _lease(storage, replica="r1", **kw):
+    kw.setdefault("ttl_s", 5.0)
+    kw.setdefault("poll_s", 0.01)
+    return L2Lease(storage, replica, **kw)
+
+
+def test_lease_acquire_hold_release(tmp_path):
+    store = _local(tmp_path)
+    lease = _lease(store)
+    token = lease.acquire("a.png")
+    assert token is not None
+    assert lease.holder("a.png") == "r1"
+    assert store.has(lease_name("a.png"))
+    lease.release("a.png", token)
+    assert lease.holder("a.png") is None
+    assert not store.has(lease_name("a.png"))
+
+
+def test_lease_second_acquire_fails_while_live(tmp_path):
+    store = _local(tmp_path)
+    leader = _lease(store, "r1")
+    follower = _lease(store, "r2")
+    token = leader.acquire("a.png")
+    assert token is not None
+    assert follower.acquire("a.png") is None
+    leader.release("a.png", token)
+    assert follower.acquire("a.png") is not None
+
+
+def test_expired_lease_is_stolen(tmp_path):
+    """A crashed leader never wedges the key: past the TTL the marker is
+    dead and the next acquire steals it."""
+    store = _local(tmp_path)
+    now = [1000.0]
+    crashed = _lease(store, "r1", clock=lambda: now[0], ttl_s=10.0)
+    assert crashed.acquire("a.png") is not None  # leader then "crashes"
+    thief = _lease(store, "r2", clock=lambda: now[0], ttl_s=10.0)
+    assert thief.acquire("a.png") is None  # still live
+    now[0] += 10.1
+    token = thief.acquire("a.png")
+    assert token is not None
+    assert thief.holder("a.png") == "r2"
+
+
+def test_malformed_marker_is_stealable(tmp_path):
+    store = _local(tmp_path)
+    store.write(lease_name("a.png"), b"not-json{")
+    lease = _lease(store)
+    assert lease.acquire("a.png") is not None
+    store.write(
+        lease_name("b.png"),
+        json.dumps({"owner": "x", "acquired_at": "garbage"}).encode(),
+    )
+    assert lease.acquire("b.png") is not None
+
+
+def test_release_leaves_a_stolen_marker_alone(tmp_path):
+    """An expired leader coming back to release must not delete the
+    marker of the replica that stole its lease."""
+    store = _local(tmp_path)
+    now = [0.0]
+    old = _lease(store, "r1", clock=lambda: now[0], ttl_s=1.0)
+    old_token = old.acquire("a.png")
+    now[0] += 2.0
+    thief = _lease(store, "r2", clock=lambda: now[0], ttl_s=10.0)
+    assert thief.acquire("a.png") is not None
+    old.release("a.png", old_token)  # stale release: no-op
+    assert thief.holder("a.png") == "r2"
+
+
+def test_two_followers_racing_one_expired_lease_single_winner(tmp_path):
+    """The write-then-confirm protocol: when two replicas race one
+    expired lease and BOTH write their marker before either confirms,
+    exactly one (the surviving marker's writer) becomes leader."""
+    store = _local(tmp_path)
+    # seed one expired marker
+    now = [0.0]
+    dead = _lease(store, "r0", clock=lambda: now[0], ttl_s=0.5)
+    dead.acquire("a.png")
+    now[0] += 1.0
+
+    # B's clock sits past A's marker TTL, so when B's acquire runs inside
+    # the race window below it reads A's fresh marker as EXPIRED and
+    # writes its own — the both-replicas-wrote interleaving
+    lease_b = _lease(store, "r2", clock=lambda: now[0] + 31.0, ttl_s=30.0)
+
+    class Interleaved(LocalStorage):
+        """A's lease write triggers B's whole acquire() BETWEEN A's
+        write and A's confirm read-back — the tightest race."""
+
+        def __init__(self, params):
+            super().__init__(params)
+            self.armed = True
+
+        def write(self, name, data):
+            out = super().write(name, data)
+            if self.armed and name == lease_name("a.png"):
+                self.armed = False
+                results["b"] = lease_b.acquire("a.png")
+            return out
+
+    results = {}
+    store_a = Interleaved(AppParameters({"upload_dir": str(tmp_path)}))
+    lease_a = _lease(store_a, "r1", clock=lambda: now[0], ttl_s=30.0)
+    results["a"] = lease_a.acquire("a.png")
+    winners = [r for r in (results["a"], results["b"]) if r is not None]
+    assert len(winners) == 1
+    # B wrote last, so B's marker survived and B leads
+    assert results["b"] is not None and results["a"] is None
+
+
+def test_lease_confirm_read_failure_claims_leadership(tmp_path):
+    """A transient read error on the confirm read-back AFTER a
+    successful marker write must claim leadership: following would park
+    every replica behind OUR OWN live marker with nobody rendering
+    until the TTL, while leading costs at most one duplicate render."""
+
+    class ConfirmBlind(LocalStorage):
+        def __init__(self, params):
+            super().__init__(params)
+            self.wrote_marker = False
+
+        def write(self, name, data):
+            out = super().write(name, data)
+            if name.endswith(".lease"):
+                self.wrote_marker = True
+            return out
+
+        def read(self, name):
+            if self.wrote_marker and name.endswith(".lease"):
+                raise OSError("transient L2 read error")
+            return super().read(name)
+
+    store = ConfirmBlind(AppParameters({"upload_dir": str(tmp_path)}))
+    lease = _lease(store)
+    assert lease.acquire("a.png") is not None
+
+
+def test_lease_write_failure_degrades_to_uncoalesced_render(tmp_path):
+    """An L2 that cannot hold markers must not stop this replica from
+    rendering — acquire claims local leadership and the miss proceeds
+    exactly as without the fleet tier."""
+
+    class NoMarkers(LocalStorage):
+        def write(self, name, data):
+            if name.endswith(".lease"):
+                raise OSError("read-only bucket")
+            return super().write(name, data)
+
+    store = NoMarkers(AppParameters({"upload_dir": str(tmp_path)}))
+    lease = _lease(store)
+    assert lease.acquire("a.png") is not None
+
+
+def test_lease_from_params_reads_knobs(tmp_path):
+    params = AppParameters({
+        "fleet_replica_id": "replica-7",
+        "l2_lease_ttl_s": 12.0,
+        "l2_lease_poll_ms": 5.0,
+        "l2_lease_wait_cap_s": 33.0,
+    })
+    lease = L2Lease.from_params(params, storage=_local(tmp_path))
+    assert lease.replica_id == "replica-7"
+    assert lease.ttl_s == 12.0
+    assert lease.poll_s == pytest.approx(0.005)
+    assert lease.wait_cap_s == 33.0
+
+
+def test_lease_names_never_collide_with_artifacts(tmp_path):
+    assert lease_name("abc.png") == "abc.png.lease"
+    store = _local(tmp_path)
+    lease = _lease(store)
+    token = lease.acquire("abc.png")
+    store.write("abc.png", b"artifact")
+    assert store.read("abc.png") == b"artifact"
+    lease.release("abc.png", token)
+    assert store.read("abc.png") == b"artifact"

@@ -340,6 +340,415 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+# ---------------------------------------------------------------------------
+# multi-replica fleet A/B (--replicas N; docs/fleet.md "Measurement")
+
+
+def _spawn_fleet(n: int, root: str, *, fleet_on: bool, mode: str = "proxy"):
+    """Spawn N app processes as one fleet. ``fleet_on`` arms rendezvous
+    routing + the shared L2 + lease; off = N isolated replicas behind a
+    dumb round-robin (today's load-balancer story, the control leg).
+    Returns (procs, urls, shared_dir)."""
+    ports = [_free_port() for _ in range(n)]
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+    shared = os.path.join(root, "shared-l2")
+    procs = []
+    for i, (port, url) in enumerate(zip(ports, urls)):
+        replica_root = os.path.join(root, f"replica-{i}")
+        os.makedirs(replica_root, exist_ok=True)
+        params_path = os.path.join(replica_root, "params.yml")
+        with open(params_path, "w") as fh:
+            fh.write("debug: true\n")
+            fh.write("reuse_enable: true\n")
+            fh.write(f"upload_dir: {os.path.join(replica_root, 'out')}\n")
+            fh.write(f"tmp_dir: {os.path.join(replica_root, 'tmp')}\n")
+            fh.write(f"fleet_replica_id: {url}\n")
+            if fleet_on:
+                fh.write(f"fleet_replicas: {json.dumps(urls)}\n")
+                fh.write(f"fleet_route: {mode}\n")
+                fh.write("l2_enable: true\n")
+                fh.write(f"l2_upload_dir: {shared}\n")
+        procs.append(subprocess.Popen(
+            [
+                sys.executable, "-m", "flyimg_tpu.service.app", "serve",
+                "--port", str(port), "--params", params_path,
+            ],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        ))
+    return procs, urls
+
+
+async def _wait_healthy(client: httpx.AsyncClient, urls: list) -> bool:
+    for url in urls:
+        for _ in range(120):
+            try:
+                r = await client.get(f"{url}/healthz")
+                if r.status_code == 200:
+                    break
+            except httpx.HTTPError:
+                pass
+            await asyncio.sleep(1.0)
+        else:
+            return False
+    return True
+
+
+def _metric_from_text(text: str, name: str) -> float:
+    for line in text.splitlines():
+        if line.startswith(name + " "):
+            try:
+                return float(line.rsplit(" ", 1)[1])
+            except ValueError:
+                pass
+    return 0.0
+
+
+async def _replica_metric(client, url: str, name: str) -> float:
+    try:
+        text = (await client.get(f"{url}/metrics")).text
+    except httpx.HTTPError:
+        return 0.0
+    return _metric_from_text(text, name)
+
+
+async def _replica_snapshot(client, url: str) -> dict:
+    """Per-replica attribution: render counts, lease outcomes, batch
+    mean-occupancy/compile amortization, distinct compiled programs —
+    ONE /metrics scrape per replica, parsed locally for every counter
+    (a per-counter round trip would perturb the system under test)."""
+    try:
+        text = (await client.get(f"{url}/metrics")).text
+    except httpx.HTTPError:
+        text = ""
+    doc = {
+        "renders": _metric_from_text(
+            text, 'flyimg_cache_total{result="miss"}'
+        ),
+        "cache_hits": _metric_from_text(
+            text, 'flyimg_cache_total{result="hit"}'
+        ),
+        "lease": {
+            outcome: _metric_from_text(
+                text, f'flyimg_l2_lease_total{{outcome="{outcome}"}}'
+            )
+            for outcome in ("lead", "coalesced", "steal", "timeout")
+        },
+        "routed": {
+            outcome: _metric_from_text(
+                text, f'flyimg_fleet_routed_total{{outcome="{outcome}"}}'
+            )
+            for outcome in ("self", "hop", "proxied", "fallback", "local")
+        },
+    }
+    batches = _metric_from_text(text, "flyimg_batches_total")
+    images = _metric_from_text(text, "flyimg_images_processed_total")
+    compile_misses = _metric_from_text(
+        text, 'flyimg_compile_events_total{result="miss"}'
+    )
+    doc["launches"] = {
+        "batches": batches,
+        "images": images,
+        # the affinity headline: owner routing concentrates one plan's
+        # stream on one replica, so launches carry more images each and
+        # each compiled program amortizes over more launches
+        "mean_batch_size": round(images / batches, 3) if batches else None,
+        "compile_misses": compile_misses,
+        "images_per_compile_miss": (
+            round(images / compile_misses, 2) if compile_misses else None
+        ),
+    }
+    try:
+        perf = (await client.get(f"{url}/debug/perf")).json()
+        device = (perf.get("controllers") or {}).get("device") or {}
+        doc["batch"] = {
+            "mean_occupancy": device.get("mean_occupancy"),
+            "batches_per_compile_miss": device.get(
+                "batches_per_compile_miss"
+            ),
+            "window_batches": device.get("window_batches"),
+        }
+    except (httpx.HTTPError, ValueError):
+        doc["batch"] = None
+    try:
+        plans = (await client.get(f"{url}/debug/plans")).json()
+        doc["distinct_programs"] = len(plans.get("plans", []))
+    except (httpx.HTTPError, ValueError):
+        doc["distinct_programs"] = None
+    return doc
+
+
+async def _fleet_hot_key_leg(client, urls: list, src: str, conc: int):
+    """ONE cold derived key, ``conc`` concurrent requests round-robin
+    across the fleet — the duplicate-render probe. Returns the leg doc
+    with per-replica render deltas (off: every replica renders it; on:
+    the lease + owner routing hold it to one render fleet-wide)."""
+    before = [
+        await _replica_metric(client, u, 'flyimg_cache_total{result="miss"}')
+        for u in urls
+    ]
+    options = "w_321,h_241,c_1,o_jpg"
+    t0 = time.perf_counter()
+
+    async def one(i: int):
+        url = f"{urls[i % len(urls)]}/upload/{options}/{src}"
+        try:
+            resp = await client.get(url)
+            return resp.status_code == 200
+        except httpx.HTTPError:
+            return False
+
+    ok = sum(await asyncio.gather(*[one(i) for i in range(conc)]))
+    elapsed = time.perf_counter() - t0
+    after = [
+        await _replica_metric(client, u, 'flyimg_cache_total{result="miss"}')
+        for u in urls
+    ]
+    renders = [a - b for a, b in zip(after, before)]
+    return {
+        "leg": "hot_key",
+        "requests": conc,
+        "ok": ok,
+        "elapsed_s": round(elapsed, 3),
+        "renders_per_replica": renders,
+        "duplicate_renders": sum(renders),
+    }
+
+
+async def _fleet_multisize_leg(client, urls: list, src: str,
+                               requests: int, conc: int):
+    """The multisize Zipf mix round-robined across the fleet: distinct
+    derived keys (q varies), same plan ladder — measures the
+    cross-replica ancestor-hit ratio (X-Flyimg-Replica/-Reuse headers)
+    and feeds the per-replica occupancy scrape."""
+    anc = await client.get(f"{urls[0]}/upload/w_800,o_jpg/{src}")
+    if anc.status_code != 200:
+        return {"leg": "multisize", "error": "ancestor warm failed"}
+    ladder = [100, 128, 160, 200, 256, 320, 400, 512, 640]
+    weights = _zipf_weights(len(ladder))
+    rng = np.random.default_rng(20260803)
+    counts = {size: 0 for size in ladder}
+    reqs = []
+    for _ in range(requests):
+        size = int(rng.choice(ladder, p=weights))
+        q = 89 - counts[size]
+        if q < 2:
+            continue
+        counts[size] += 1
+        h = int(size * 3 / 4)
+        reqs.append(f"w_{size},h_{h},c_1,q_{q},o_jpg")
+    samples: list = []
+    failures = [0]
+    it = iter(enumerate(reqs))
+
+    async def worker():
+        while True:
+            item = next(it, None)
+            if item is None:
+                return
+            i, options = item
+            url = f"{urls[i % len(urls)]}/upload/{options}/{src}"
+            t0 = time.perf_counter()
+            try:
+                resp = await client.get(url)
+                ok = resp.status_code == 200 and len(resp.content) > 0
+            except httpx.HTTPError:
+                ok = False
+                resp = None
+            if ok:
+                samples.append((
+                    time.perf_counter() - t0,
+                    "X-Flyimg-Reuse" in resp.headers,
+                    resp.headers.get("X-Flyimg-Replica", ""),
+                ))
+            else:
+                failures[0] += 1
+
+    t0 = time.perf_counter()
+    await asyncio.gather(*[worker() for _ in range(conc)])
+    elapsed = time.perf_counter() - t0
+    lat = np.asarray([s[0] for s in samples]) * 1000.0
+    hits = sum(1 for s in samples if s[1])
+    by_renderer: dict = {}
+    for _, _, renderer in samples:
+        if renderer:
+            by_renderer[renderer] = by_renderer.get(renderer, 0) + 1
+    return {
+        "leg": "multisize",
+        "requests": len(reqs),
+        "ok": len(samples),
+        "failures": failures[0],
+        "elapsed_s": round(elapsed, 3),
+        "throughput_rps": round(len(samples) / elapsed, 1) if elapsed else 0,
+        "ancestor_hit_ratio": (
+            round(hits / len(samples), 4) if samples else 0.0
+        ),
+        "latency_ms": {
+            "p50": round(float(np.percentile(lat, 50)), 2),
+            "p99": round(float(np.percentile(lat, 99)), 2),
+        } if len(lat) else None,
+        "served_by": by_renderer,
+    }
+
+
+async def _fleet_ab(args) -> int:
+    """The --replicas A/B: one fleet with routing+L2+lease on, one
+    control fleet of isolated replicas, same legs, one artifact
+    (benchmarks/FLEET_r01.json)."""
+    import shutil
+    import tempfile
+
+    n = args.replicas
+    configs = [("fleet_on", True), ("fleet_off", False)]
+    results = {}
+    for name, fleet_on in configs:
+        root = tempfile.mkdtemp(prefix=f"flyimg-fleet-{name}-")
+        procs, urls = _spawn_fleet(
+            n, root, fleet_on=fleet_on, mode=args.fleet_route
+        )
+        try:
+            async with httpx.AsyncClient(
+                timeout=120.0, limits=httpx.Limits(max_connections=256)
+            ) as client:
+                if not await _wait_healthy(client, urls):
+                    print(f"{name}: fleet never became healthy",
+                          file=sys.stderr)
+                    return 1
+                src = _make_source(args.source)
+                # the multisize leg gets its OWN source: the hot-key leg
+                # already ran index lookups on the first one, and the
+                # variant index's short negative-lookup memo
+                # (runtime/variantindex.py NEGATIVE_TTL_S) would
+                # honestly suppress reuse on it for up to 30 s
+                src_multi = _make_source(
+                    os.path.join(
+                        os.path.dirname(args.source) or ".",
+                        "bench-fleet-multisize.jpg",
+                    ),
+                    seed=4242,
+                )
+                print(f"== {name}: {n} replicas "
+                      f"({'routing+L2+lease' if fleet_on else 'isolated'})")
+                hot = await _fleet_hot_key_leg(
+                    client, urls, src, conc=4 * n
+                )
+                print(
+                    f"  hot key: {hot['duplicate_renders']:.0f} renders "
+                    f"for {hot['requests']} concurrent requests "
+                    f"(per replica {hot['renders_per_replica']})"
+                )
+                multi = await _fleet_multisize_leg(
+                    client, urls, src_multi, args.mix_requests, args.conc
+                )
+                print(
+                    f"  multisize: ratio {multi.get('ancestor_hit_ratio')} "
+                    f"rps {multi.get('throughput_rps')} "
+                    f"p50 {(multi.get('latency_ms') or {}).get('p50')}ms "
+                    f"served_by {multi.get('served_by')}"
+                )
+                replicas = {
+                    url: await _replica_snapshot(client, url)
+                    for url in urls
+                }
+                for url, snap in replicas.items():
+                    batch = snap.get("batch") or {}
+                    launches = snap.get("launches") or {}
+                    print(
+                        f"    {url}: renders {snap['renders']:.0f} "
+                        f"occupancy {batch.get('mean_occupancy')} "
+                        f"batch_size {launches.get('mean_batch_size')} "
+                        f"programs {snap.get('distinct_programs')} "
+                        f"img/compile {launches.get('images_per_compile_miss')}"
+                    )
+                results[name] = {
+                    "replicas": n,
+                    "mode": args.fleet_route if fleet_on else None,
+                    "hot_key": hot,
+                    "multisize": multi,
+                    "per_replica": replicas,
+                }
+        finally:
+            for proc in procs:
+                proc.send_signal(signal.SIGTERM)
+            for proc in procs:
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+            shutil.rmtree(root, ignore_errors=True)
+
+    def _occupancies(doc):
+        return [
+            (snap.get("batch") or {}).get("mean_occupancy")
+            for snap in doc["per_replica"].values()
+        ]
+
+    artifact = {
+        "what": (
+            "Multi-replica fleet A/B (docs/fleet.md): rendezvous routing "
+            "+ shared L2 + cross-replica lease vs N isolated replicas "
+            "behind round-robin — duplicate renders of one hot key, "
+            "cross-replica ancestor-hit ratio on the multisize Zipf mix, "
+            "and per-replica batch occupancy / distinct compiled programs"
+        ),
+        "method": (
+            f"bench_http --replicas {n} --fleet-route {args.fleet_route} "
+            f"--mix-requests {args.mix_requests} --conc {args.conc}; "
+            "every replica a spawned process on this host; client "
+            "round-robins requests across replicas"
+        ),
+        "backend": os.environ.get("JAX_PLATFORMS", "default"),
+        "legs": results,
+        "summary": {
+            "hot_key_renders_on": results["fleet_on"]["hot_key"][
+                "duplicate_renders"
+            ],
+            "hot_key_renders_off": results["fleet_off"]["hot_key"][
+                "duplicate_renders"
+            ],
+            "ancestor_hit_ratio_on": results["fleet_on"]["multisize"].get(
+                "ancestor_hit_ratio"
+            ),
+            "ancestor_hit_ratio_off": results["fleet_off"][
+                "multisize"
+            ].get("ancestor_hit_ratio"),
+            "mean_occupancy_on": _occupancies(results["fleet_on"]),
+            "mean_occupancy_off": _occupancies(results["fleet_off"]),
+            "mean_batch_size_on": [
+                (snap.get("launches") or {}).get("mean_batch_size")
+                for snap in results["fleet_on"]["per_replica"].values()
+            ],
+            "mean_batch_size_off": [
+                (snap.get("launches") or {}).get("mean_batch_size")
+                for snap in results["fleet_off"]["per_replica"].values()
+            ],
+            "distinct_programs_on": [
+                snap.get("distinct_programs")
+                for snap in results["fleet_on"]["per_replica"].values()
+            ],
+            "distinct_programs_off": [
+                snap.get("distinct_programs")
+                for snap in results["fleet_off"]["per_replica"].values()
+            ],
+            "images_per_compile_miss_on": [
+                (snap.get("launches") or {}).get("images_per_compile_miss")
+                for snap in results["fleet_on"]["per_replica"].values()
+            ],
+            "images_per_compile_miss_off": [
+                (snap.get("launches") or {}).get("images_per_compile_miss")
+                for snap in results["fleet_off"]["per_replica"].values()
+            ],
+        },
+    }
+    print(json.dumps(artifact["summary"]))
+    if args.fleet_out:
+        with open(args.fleet_out, "w") as fh:
+            json.dump(artifact, fh, indent=1)
+            fh.write("\n")
+        print(f"wrote {args.fleet_out}")
+    return 0
+
+
 async def _scrape_observability(client: httpx.AsyncClient, base: str):
     """End-of-run attribution scrape: batch efficiency (/debug/perf),
     the per-plan cost ledger (/debug/plans), and the flight-recorder
@@ -464,7 +873,29 @@ async def main() -> int:
         help="host stage DAG for the spawned service "
              "(host_pipeline_enable; docs/host-pipeline.md), stamped "
              "into every result row. With --base it only stamps the rows")
+    ap.add_argument(
+        "--replicas", type=int, default=0,
+        help="multi-replica fleet A/B (docs/fleet.md): spawn N app "
+             "processes behind a round-robin client, once with "
+             "rendezvous routing + shared L2 + cross-replica lease and "
+             "once isolated (the control), measuring hot-key duplicate "
+             "renders, cross-replica ancestor-hit ratio, and per-replica "
+             "batch occupancy. Replaces the standard scenarios")
+    ap.add_argument(
+        "--fleet-route", default="proxy", choices=("proxy", "local"),
+        help="non-owner behavior in the fleet-on leg (fleet_route knob)")
+    ap.add_argument(
+        "--fleet-out", default=None,
+        help="write the fleet A/B artifact to this JSON path "
+             "(e.g. benchmarks/FLEET_r01.json)")
     args = ap.parse_args()
+
+    if args.replicas:
+        if args.base:
+            print("--replicas spawns its own fleet; --base conflicts",
+                  file=sys.stderr)
+            return 2
+        return await _fleet_ab(args)
 
     if args.base and args.spawn:
         print("--base and --spawn are mutually exclusive", file=sys.stderr)

@@ -6,7 +6,14 @@ encode cost, smooth enough to be photo-like). Deterministic; the set is
 gitignored and regenerated on demand:
 
     python tools/gen_bench_images.py [--out var/bench_images] [--n 1000]
-"""
+
+``--progressive N`` additionally writes the first N images as
+progressive-scan twins (``imgNNNNp.jpg`` — same pixels, same quality,
+scan-interleaved coefficients). They feed the progressive leg of
+tools/host_codec_bench.py: ROI decode's row-skip half cannot skip work
+the progressive entropy decode has already paid, and the twin corpus is
+what measures how much of the ROI win survives
+(docs/host-pipeline.md "Progressive sources")."""
 
 from __future__ import annotations
 
@@ -21,6 +28,12 @@ def main() -> int:
     ap.add_argument("--out", default="var/bench_images")
     ap.add_argument("--n", type=int, default=1000)
     ap.add_argument("--size", type=int, default=512)
+    ap.add_argument(
+        "--progressive", type=int, default=0,
+        help="also write the first N images as progressive-scan twins "
+             "(imgNNNNp.jpg), for the progressive ROI-decode leg of "
+             "tools/host_codec_bench.py",
+    )
     args = ap.parse_args()
 
     from PIL import Image
@@ -50,7 +63,22 @@ def main() -> int:
         img = np.clip(img + noise, 0, 255).astype(np.uint8)
         Image.fromarray(img).save(path, "JPEG", quality=90)
         made += 1
-    print(f"{made} generated, {args.n - made} already present, -> {args.out}")
+    prog_made = 0
+    for i in range(min(args.progressive, args.n)):
+        src = os.path.join(args.out, f"img{i:04d}.jpg")
+        twin = os.path.join(args.out, f"img{i:04d}p.jpg")
+        if os.path.exists(twin) or not os.path.exists(src):
+            continue
+        with Image.open(src) as im:
+            im.convert("RGB").save(
+                twin, "JPEG", quality=90, progressive=True
+            )
+        prog_made += 1
+    print(
+        f"{made} generated, {args.n - made} already present"
+        + (f", {prog_made} progressive twins" if args.progressive else "")
+        + f", -> {args.out}"
+    )
     return 0
 
 

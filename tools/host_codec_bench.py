@@ -60,11 +60,91 @@ def _pool_throughput(run_batch, items, repeats: int = 3) -> float:
     return round(best, 1)
 
 
+def _progressive_roi_leg(src_dir: str, blobs, row):
+    """Full vs ROI-window decode throughput on sequential and
+    progressive twins of the same pixels. The window is the serving
+    shape's worst honest case: a centered 128x128 of the 512^2 frame at
+    full scale (1/16 of the pixels) — sequential sources skip 3/4 of the
+    scanline work; progressive sources have already entropy-decoded
+    every scan before the first pixel lands, so only IDCT+color on the
+    skipped rows can be saved. Emits one `progressive_roi` doc with the
+    four throughput corners and the derived speedup ratios."""
+    from flyimg_tpu.codecs import native_codec
+
+    # progressive twins: prefer the committed corpus files, re-encode in
+    # memory when the corpus predates --progressive
+    import io as _io
+
+    from PIL import Image
+
+    names = sorted(
+        n for n in os.listdir(src_dir)
+        if n.endswith("p.jpg")
+    )[: len(blobs)]
+    prog_blobs = []
+    for n in names:
+        with open(os.path.join(src_dir, n), "rb") as fh:
+            prog_blobs.append(fh.read())
+    while len(prog_blobs) < len(blobs):
+        i = len(prog_blobs)
+        im = Image.open(_io.BytesIO(blobs[i])).convert("RGB")
+        buf = _io.BytesIO()
+        im.save(buf, "JPEG", quality=90, progressive=True)
+        prog_blobs.append(buf.getvalue())
+
+    window = (192, 192, 128, 128)  # centered 1/16-frame window
+    roi_ok = native_codec.roi_supported()
+    doc = {
+        "window": list(window),
+        "roi_supported": roi_ok,
+        "corpus_twins": len(names),
+    }
+    legs = {}
+    for kind, body in (("sequential", blobs), ("progressive", prog_blobs)):
+        full = _throughput(
+            lambda b: native_codec.jpeg_decode(b, 8), body
+        )
+        row(f"jpeg_decode_full_{kind}", full)
+        legs[kind] = {"full_ips": full}
+        if roi_ok:
+            sample = native_codec.jpeg_decode_roi(body[0], 8, window)
+            legs[kind]["roi_returns"] = sample is not None
+            if sample is not None:
+                roi = _throughput(
+                    lambda b: native_codec.jpeg_decode_roi(b, 8, window),
+                    body,
+                )
+                row(f"jpeg_decode_roi_{kind}", roi)
+                legs[kind]["roi_ips"] = roi
+                legs[kind]["roi_speedup"] = (
+                    round(roi / full, 2) if full else None
+                )
+    doc["legs"] = legs
+    if all("roi_speedup" in legs.get(k, {}) for k in ("sequential",
+                                                      "progressive")):
+        doc["progressive_win_share"] = round(
+            (legs["progressive"]["roi_speedup"] - 1.0)
+            / max(legs["sequential"]["roi_speedup"] - 1.0, 1e-9),
+            3,
+        )
+    return doc
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="benchmarks/host_codec_r5.json")
     ap.add_argument("--src", default="var/bench_images")
     ap.add_argument("--n", type=int, default=120)
+    ap.add_argument(
+        "--progressive-roi", action="store_true",
+        help="add the progressive ROI-decode leg (docs/host-pipeline.md "
+             "'Progressive sources'): full vs windowed decode on "
+             "sequential AND progressive twins of the same pixels — how "
+             "much of the ROI row-skip win survives scan-interleaved "
+             "coefficients. Twins come from the corpus (imgNNNNp.jpg, "
+             "tools/gen_bench_images.py --progressive) or are re-encoded "
+             "in memory when absent",
+    )
     args = ap.parse_args()
 
     from PIL import Image
@@ -137,6 +217,10 @@ def main() -> int:
                 ),
             )
 
+    progressive_doc = None
+    if args.progressive_roi:
+        progressive_doc = _progressive_roi_leg(src, blobs, row)
+
     # bytes-per-tier on the same outputs: the speed/size tradeoff the
     # deployment-shape statement needs
     sizes = {}
@@ -165,6 +249,8 @@ def main() -> int:
         "results": results,
         "mean_encoded_bytes_300x250_q90": sizes,
     }
+    if progressive_doc is not None:
+        artifact["progressive_roi"] = progressive_doc
     out_path = os.path.join(REPO, args.out)
     with open(out_path, "w") as fh:
         json.dump(artifact, fh, indent=1)

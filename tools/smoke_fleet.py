@@ -1,0 +1,212 @@
+"""CI fleet smoke: two replicas over ONE shared local L2 prove the
+fleet tier end to end (docs/fleet.md):
+
+- a cold hot key requested on BOTH replicas concurrently renders
+  exactly ONCE fleet-wide (lease + coalesce, proven via
+  ``flyimg_cache_total{result="miss"}`` and
+  ``flyimg_l2_lease_total{outcome=}`` on both replicas), and both
+  responses carry byte-identical bodies;
+- replica B serves an ancestor HIT (``X-Flyimg-Reuse``) for a small
+  rendition whose only ancestor was rendered by replica A — the variant
+  manifest travelled through the shared tier;
+- wire parity: B's reuse render is within 2 u8 of a single-replica
+  control app rendering the same request from source.
+
+    JAX_PLATFORMS=cpu python tools/smoke_fleet.py
+
+Exit code 0 = every assertion held. The behavioral matrix (router
+units, lease edge cases, proxy fallbacks) lives in tests/test_fleet.py
+and tests/test_tiered_storage.py; this script proves the assembled
+service coalesces as one fleet."""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+import tempfile
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def _require(cond: bool, what: str) -> None:
+    if not cond:
+        print(f"FAIL: {what}", file=sys.stderr)
+        raise SystemExit(1)
+
+
+def _metric_value(text: str, name: str) -> float:
+    for line in text.splitlines():
+        if line.startswith(name + " "):
+            try:
+                return float(line.rsplit(" ", 1)[1])
+            except ValueError:
+                continue
+    return 0.0
+
+
+async def _metric(client, name: str) -> float:
+    return _metric_value(await (await client.get("/metrics")).text(), name)
+
+
+async def main() -> int:
+    import numpy as np
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from flyimg_tpu.appconfig import AppParameters
+    from flyimg_tpu.codecs import decode, encode
+    from flyimg_tpu.service.app import make_app
+
+    tmp = tempfile.mkdtemp(prefix="flyimg-fleet-smoke-")
+    yy, xx = np.mgrid[0:384, 0:512].astype(np.float32)
+    rgb = np.stack(
+        [xx * (255.0 / 511.0), yy * (255.0 / 383.0),
+         (xx + yy) * (255.0 / 894.0)],
+        axis=-1,
+    ).astype(np.uint8)
+    src = os.path.join(tmp, "src.png")
+    with open(src, "wb") as fh:
+        fh.write(encode(rgb, "png"))
+
+    shared = os.path.join(tmp, "shared-l2")
+
+    def params(sub: str, fleet: bool) -> AppParameters:
+        doc = {
+            "tmp_dir": os.path.join(tmp, sub, "t"),
+            "upload_dir": os.path.join(tmp, sub, "u"),
+            "debug": True,
+            "reuse_enable": True,
+        }
+        if fleet:
+            doc.update({
+                "l2_enable": True,
+                "l2_upload_dir": shared,
+                "fleet_replica_id": f"replica-{sub}",
+            })
+        return AppParameters(doc)
+
+    replica_a = TestClient(TestServer(make_app(params("a", True))))
+    replica_b = TestClient(TestServer(make_app(params("b", True))))
+    control = TestClient(TestServer(make_app(params("control", False))))
+    await replica_a.start_server()
+    await replica_b.start_server()
+    await control.start_server()
+    try:
+        # 1) hot key: both replicas miss concurrently, ONE render total.
+        # A fresh process compiles the program on its first render, so
+        # the two arrivals overlap by seconds; a retry key absorbs the
+        # (theoretical) perfect-miss interleave.
+        for attempt, width in enumerate((301, 303)):
+            hot = f"w_{width},h_225,c_1,o_jpg"
+            resp_a, resp_b = await asyncio.gather(
+                replica_a.get(f"/upload/{hot}/{src}"),
+                replica_b.get(f"/upload/{hot}/{src}"),
+            )
+            _require(
+                resp_a.status == 200 and resp_b.status == 200,
+                f"hot-key renders 200/200 (got {resp_a.status}/"
+                f"{resp_b.status})",
+            )
+            body_a = await resp_a.read()
+            body_b = await resp_b.read()
+            _require(
+                body_a == body_b,
+                "both replicas serve byte-identical hot-key bodies",
+            )
+            renders = sum([
+                await _metric(
+                    replica_a, 'flyimg_cache_total{result="miss"}'
+                ),
+                await _metric(
+                    replica_b, 'flyimg_cache_total{result="miss"}'
+                ),
+            ])
+            leads = sum([
+                await _metric(
+                    replica_a, 'flyimg_l2_lease_total{outcome="lead"}'
+                ),
+                await _metric(
+                    replica_b, 'flyimg_l2_lease_total{outcome="lead"}'
+                ),
+            ])
+            coalesced = sum([
+                await _metric(
+                    replica_a, 'flyimg_l2_lease_total{outcome="coalesced"}'
+                ),
+                await _metric(
+                    replica_b, 'flyimg_l2_lease_total{outcome="coalesced"}'
+                ),
+            ])
+            _require(
+                renders == attempt + 1,
+                f"hot key rendered exactly once fleet-wide "
+                f"(total misses {renders}, attempt {attempt})",
+            )
+            _require(
+                leads == attempt + 1,
+                f"exactly one lease leader (leads {leads})",
+            )
+            if coalesced >= 1:
+                break  # the lease visibly coalesced the second replica
+        _require(
+            coalesced >= 1,
+            f"the second replica coalesced on the leader's lease "
+            f"(coalesced {coalesced})",
+        )
+
+        # 2) cross-replica ancestor hit: A seeds the pure ancestor, B
+        # serves a small rendition from it via the shared manifest.
+        # A SECOND source: the hot-key leg above already ran lookups on
+        # the first one, and the variant index's short negative-lookup
+        # memo (runtime/variantindex.py NEGATIVE_TTL_S) would honestly
+        # report "nothing indexed yet" for it for up to 30 s
+        src2 = os.path.join(tmp, "src2.png")
+        with open(src2, "wb") as fh2:
+            fh2.write(encode(rgb[::-1].copy(), "png"))
+        src = src2
+        big = await replica_a.get(f"/upload/w_256,o_png/{src}")
+        _require(big.status == 200, f"ancestor render 200 ({big.status})")
+        small = await replica_b.get(f"/upload/w_120,h_90,c_1,o_png/{src}")
+        _require(small.status == 200, f"reuse render 200 ({small.status})")
+        _require(
+            "X-Flyimg-Reuse" in small.headers,
+            "replica B reuse-served from replica A's rendition "
+            f"(headers {dict(small.headers)})",
+        )
+        _require(
+            small.headers.get("X-Flyimg-Replica") == "replica-b",
+            "debug replica attribution names the renderer",
+        )
+        b_hits = await _metric(
+            replica_b, 'flyimg_reuse_hits_total{outcome="hit"}'
+        )
+        _require(b_hits == 1.0, f"B's reuse hit counter moved ({b_hits})")
+
+        # 3) wire parity vs the single-replica control
+        base = await control.get(f"/upload/w_120,h_90,c_1,o_png/{src}")
+        _require(base.status == 200, f"control render 200 ({base.status})")
+        got = decode(await small.read()).rgb.astype(int)
+        want = decode(await base.read()).rgb.astype(int)
+        _require(got.shape == want.shape, "fleet/control dims agree")
+        diff = int(np.abs(got - want).max())
+        _require(diff <= 2, f"wire parity within 2 u8 (max {diff})")
+        _require(
+            "X-Flyimg-Replica" not in base.headers,
+            "control app emits no fleet headers",
+        )
+
+        print(
+            "fleet smoke OK: hot key rendered once across two replicas "
+            f"(lease lead+coalesce), cross-replica ancestor hit served, "
+            f"wire parity max diff {diff} u8"
+        )
+        return 0
+    finally:
+        await replica_a.close()
+        await replica_b.close()
+        await control.close()
+
+
+if __name__ == "__main__":
+    raise SystemExit(asyncio.run(main()))

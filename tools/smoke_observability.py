@@ -215,7 +215,12 @@ async def main() -> int:
         _require(
             fr_doc["summary"]["records"] >= 1, "flight-recorder records"
         )
-        launch = fr_doc["records"][0]
+        # the ring interleaves device launches with host_stage rows now
+        # that the stage DAG defaults on (PR 12 flip): the device-split
+        # assertions apply to the first DEVICE launch record
+        launch = next(
+            r for r in fr_doc["records"] if r.get("stage") is None
+        )
         for field in ("h2d_s", "dispatch_s", "sync_s", "device_s"):
             _require(
                 launch[field] is not None and launch[field] >= 0,
