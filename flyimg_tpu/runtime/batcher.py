@@ -245,8 +245,15 @@ class BatchController:
         from flyimg_tpu.runtime.resilience import AdmissionGate
 
         self.name = name
-        self.max_batch = max_batch
-        self.deadline_s = deadline_ms / 1000.0
+        # the LIVE flush policy as ONE atomic (max_batch, deadline_s)
+        # tuple: every flush decision reads the pair through a single
+        # reference load, so an online policy update (apply_policy — the
+        # autotuner's write path, docs/autotuning.md) can never be
+        # observed half-applied (a new batch size with the old timeout).
+        # The max_batch/deadline_s properties keep the original read API.
+        self._policy: Tuple[int, float] = (
+            int(max_batch), deadline_ms / 1000.0,
+        )
         # flush a lone request immediately when the device is idle (cuts
         # sparse-traffic p99 by deadline_ms; disable for deterministic
         # batch-forming in tests)
@@ -330,6 +337,47 @@ class BatchController:
         # submitter would mis-read it as dead and heal AGAIN
         self._executor_pending = False
         self._spawn_executor().start()
+
+    # -- live flush policy (runtime/autotuner.py writes here) ----------
+
+    @property
+    def max_batch(self) -> int:
+        return self._policy[0]
+
+    @property
+    def deadline_s(self) -> float:
+        return self._policy[1]
+
+    def policy(self) -> Tuple[int, float]:
+        """The current ``(max_batch, deadline_s)`` pair, read atomically
+        (one reference load — the same guarantee every flush decision
+        gets)."""
+        return self._policy
+
+    def apply_policy(
+        self,
+        max_batch: Optional[int] = None,
+        deadline_ms: Optional[float] = None,
+    ) -> Tuple[int, float]:
+        """Install a new flush policy online. Both fields swap as ONE
+        tuple under the controller lock, and the executor is notified so
+        a shortened deadline re-arms its wait immediately instead of
+        sleeping out the old one. Values are clamped to sane floors;
+        the ENVELOPE (how far and how fast policy may move) is the
+        autotuner's contract, not this method's."""
+        with self._lock:
+            cur_batch, cur_deadline = self._policy
+            new_batch = (
+                max(1, min(int(max_batch), MAX_BATCH_BUCKET))
+                if max_batch is not None else cur_batch
+            )
+            new_deadline = (
+                max(float(deadline_ms), 0.0) / 1000.0
+                if deadline_ms is not None else cur_deadline
+            )
+            self._policy = (new_batch, new_deadline)
+            self._lock.notify_all()
+            return self._policy
 
     def _spawn_executor(self) -> threading.Thread:
         """Install (or, from self-healing, replace) THE executor thread
@@ -815,7 +863,8 @@ class BatchController:
             if not member.future.done():
                 member.future.set_exception(exc)
 
-    def _group_ready(self, group: _Group, now: float, total_pending: int) -> bool:
+    def _group_ready(self, group: _Group, now: float, total_pending: int,
+                     policy: Tuple[int, float]) -> bool:
         """The ONE flush-readiness predicate (used by both the wait loop and
         the pop — drift between two copies would make _run busy-spin):
         batch full, deadline expired, or the lone-request fast path. The
@@ -823,26 +872,32 @@ class BatchController:
         this means the chip is idle — holding a single request for the
         deadline buys no batching (any later arrival lands in the next
         batch, which forms while this one executes). Cuts sparse-traffic
-        p99 by deadline_ms (SURVEY.md section 7 hard part 2)."""
-        if len(group.members) >= self.max_batch:
+        p99 by deadline_ms (SURVEY.md section 7 hard part 2).
+        ``policy`` is the caller's one-shot read of ``self._policy``: one
+        decision pass must judge every group against ONE (size, timeout)
+        pair even if apply_policy lands mid-pass."""
+        max_batch, deadline_s = policy
+        if len(group.members) >= max_batch:
             return True
-        if now - group.members[0].enqueued_at >= self.deadline_s:
+        if now - group.members[0].enqueued_at >= deadline_s:
             return True
         return self.lone_flush and total_pending == 1
 
     def _ready_group(self) -> bool:
         now = time.monotonic()
+        policy = self._policy
         total_pending = sum(len(g.members) for g in self._groups.values())
         return any(
-            self._group_ready(group, now, total_pending)
+            self._group_ready(group, now, total_pending, policy)
             for group in self._groups.values()
             if group.members
         )
 
     def _next_deadline(self) -> Optional[float]:
         now = time.monotonic()
+        deadline_s = self._policy[1]
         deadlines = [
-            group.members[0].enqueued_at + self.deadline_s - now
+            group.members[0].enqueued_at + deadline_s - now
             for group in self._groups.values()
             if group.members
         ]
@@ -852,6 +907,8 @@ class BatchController:
 
     def _pop_ready_group(self) -> Optional[_Group]:
         now = time.monotonic()
+        policy = self._policy
+        max_batch, deadline_s = policy
         total_pending = sum(len(g.members) for g in self._groups.values())
         best = None
         best_score = None
@@ -861,7 +918,7 @@ class BatchController:
             if not group.members:
                 self._groups.pop(key, None)
                 continue
-            if not self._group_ready(group, now, total_pending):
+            if not self._group_ready(group, now, total_pending, policy):
                 continue
             age = now - group.members[0].enqueued_at
             # starvation guard: full groups normally win (throughput), but
@@ -870,9 +927,9 @@ class BatchController:
             # service time routinely exceeds a few deadlines, so a bare
             # 4x-deadline trigger would fire on nearly every pop under
             # load and collapse the fullest-group policy into oldest-first
-            if age >= max(4.0 * self.deadline_s, 0.25) and age > starving_age:
+            if age >= max(4.0 * deadline_s, 0.25) and age > starving_age:
                 starving, starving_age = key, age
-            full = len(group.members) >= self.max_batch
+            full = len(group.members) >= max_batch
             score = (1 if full else 0, len(group.members))
             if best_score is None or score > best_score:
                 best, best_score = key, score
@@ -881,8 +938,8 @@ class BatchController:
         if best is None:
             return None
         group = self._groups[best]
-        take = group.members[: self.max_batch]
-        group.members = group.members[self.max_batch :]
+        take = group.members[:max_batch]
+        group.members = group.members[max_batch:]
         if not group.members:
             self._groups.pop(best, None)
         ready = _Group(

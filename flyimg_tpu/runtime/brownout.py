@@ -114,6 +114,7 @@ class BrownoutEngine:
         queue_ref: float = 64.0,
         inflight_ref: float = 0.0,
         breaker_ref: float = 0.0,
+        lease_ref: float = 8.0,
         quality: int = 40,
         stale_ttl_s: float = 300.0,
         refresh_max_pending: int = 8,
@@ -132,6 +133,7 @@ class BrownoutEngine:
         self.queue_ref = max(float(queue_ref), 1.0)
         self.inflight_ref = float(inflight_ref)
         self.breaker_ref = float(breaker_ref)
+        self.lease_ref = float(lease_ref)
         self.quality = int(quality)
         self.stale_ttl_s = float(stale_ttl_s)
         self.shed_retry_after_s = float(shed_retry_after_s)
@@ -157,6 +159,7 @@ class BrownoutEngine:
         self._inflight_fn: Optional[Callable[[], float]] = None
         self._breaker_open_fn: Optional[Callable[[], float]] = None
         self._host_pipeline = None
+        self._lease_waiters_fn: Optional[Callable[[], float]] = None
         self.refresh = RefreshQueue(
             max_pending=refresh_max_pending, metrics=metrics
         )
@@ -182,6 +185,7 @@ class BrownoutEngine:
             or 64.0,
             inflight_ref=float(params.by_key("brownout_inflight_ref", 0.0)),
             breaker_ref=float(params.by_key("brownout_breaker_ref", 0.0)),
+            lease_ref=float(params.by_key("brownout_lease_ref", 8.0)),
             quality=int(params.by_key("brownout_quality", 40)),
             stale_ttl_s=float(params.by_key("brownout_stale_ttl_s", 300.0)),
             refresh_max_pending=int(
@@ -202,19 +206,23 @@ class BrownoutEngine:
         self._transition_listeners.append(listener)
 
     def attach(self, *, batchers=(), slo=None, inflight_fn=None,
-               breaker_open_fn=None, host_pipeline=None) -> None:
+               breaker_open_fn=None, host_pipeline=None,
+               lease_waiters_fn=None) -> None:
         """Wire the live pressure sources (service/app.py): batch
         controllers (queue depth + efficiency window), the SLO engine
         (burn rates), the inflight-request gauge, the breaker registry's
-        open count, and the host stage-DAG (runtime/hostpipeline.py —
-        its worst stage-pool saturation, 1.0 = a stage at its admission
-        bound). All optional — a missing source simply contributes no
-        pressure."""
+        open count, the host stage-DAG (runtime/hostpipeline.py — its
+        worst stage-pool saturation, 1.0 = a stage at its admission
+        bound), and the L2 lease follower count (storage/tiered.py
+        ``L2Lease.waiters`` — threads parked behind a remote leader are
+        load, not idleness). All optional — a missing source simply
+        contributes no pressure."""
         self._batchers = tuple(batchers)
         self._slo = slo
         self._inflight_fn = inflight_fn
         self._breaker_open_fn = breaker_open_fn
         self._host_pipeline = host_pipeline
+        self._lease_waiters_fn = lease_waiters_fn
 
     def register_metrics(self, registry) -> None:
         """Render-time gauges on the shared registry: the level an
@@ -276,6 +284,17 @@ class BrownoutEngine:
                 # bound): a saturated decode pool is host overload the
                 # batcher queues can't see (runtime/hostpipeline.py)
                 out["host_stage"] = float(self._host_pipeline.pressure())
+            except Exception:
+                pass
+        if self._lease_waiters_fn is not None and self.lease_ref > 0:
+            try:
+                # followers blocked in an L2Lease wait (a fleet-wide
+                # hot-key stampede): each parked request thread is load
+                # this replica is carrying even though its own queues
+                # look empty (docs/degradation.md "Lease-aware pressure")
+                out["l2_lease"] = (
+                    float(self._lease_waiters_fn()) / self.lease_ref
+                )
             except Exception:
                 pass
         # a failing pressure source degrades to no-signal: the engine

@@ -153,8 +153,40 @@ class FleetRouter:
     def proxies(self) -> bool:
         return self.enabled and self.mode == "proxy"
 
+    def update_replicas(
+        self,
+        replicas: List[str],
+        self_id: Optional[str] = None,
+    ) -> Dict[str, object]:
+        """Swap the replica set online (docs/fleet.md "Dynamic replica
+        sets"): the debug-gated ``POST /debug/fleet/replicas`` endpoint
+        and the serve-mode SIGHUP config re-read both land here. The new
+        list replaces ``self.replicas`` as ONE reference swap, so every
+        ``owner()`` call routes against either the old set or the new —
+        never a half-updated one — and requests already proxying against
+        an old owner complete normally (they captured the owner URL
+        before the swap; HRW re-homes only the changed replicas' keys).
+        Returns the applied routing snapshot."""
+        new = [str(r).rstrip("/") for r in replicas if str(r)]
+        if self_id is not None:
+            self.self_id = str(self_id).rstrip("/")
+        self.replicas = new
+        return {
+            "replicas": list(new),
+            "replica_id": self.self_id,
+            "mode": self.mode,
+            "enabled": self.enabled,
+        }
+
     def owner(self, key: str) -> str:
-        return rendezvous_owner(self.replicas, key)
+        # ONE reference read: a concurrent update_replicas (POST
+        # endpoint, SIGHUP) swaps the list between this replica's
+        # enabled check and the owner resolution, and an emptied set
+        # must resolve to "render locally", never a 500
+        replicas = self.replicas
+        if not replicas:
+            return self.self_id
+        return rendezvous_owner(replicas, key)
 
     def is_owner(self, key: str) -> bool:
         return self.owner(key) == self.self_id

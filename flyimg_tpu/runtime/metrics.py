@@ -227,11 +227,16 @@ class BatchEfficiency:
         self._lock = threading.Lock()
         # (images, capacity, queue_wait_s, device_s, compile_hit|None)
         self._entries: deque = deque(maxlen=max(1, int(window)))
+        # monotone launches-ever-recorded counter: the rolling window
+        # itself never expires by time, so consumers that need RECENCY
+        # (the autotuner's since-last-evaluation launch delta) diff this
+        self._recorded_total = 0
 
     def record(self, *, images: int, capacity: int, queue_wait_s: float,
                device_s: Optional[float],
                compile_hit: Optional[bool]) -> None:
         with self._lock:
+            self._recorded_total += 1
             self._entries.append((
                 int(images), int(capacity), max(float(queue_wait_s), 0.0),
                 float(device_s) if device_s is not None else 0.0,
@@ -241,12 +246,14 @@ class BatchEfficiency:
     def stats(self) -> Dict[str, float]:
         with self._lock:
             entries = list(self._entries)
+            recorded_total = self._recorded_total
         if not entries:
             return {
                 "window_batches": 0, "mean_occupancy": 0.0,
                 "padding_waste": 0.0, "queue_wait_share": 0.0,
                 "batches_per_compile_miss": 0.0,
                 "mean_queue_wait_ms": 0.0, "mean_device_ms": 0.0,
+                "recorded_total": 0,
             }
         images = sum(e[0] for e in entries)
         slots = sum(e[1] for e in entries)
@@ -273,6 +280,7 @@ class BatchEfficiency:
             ),
             "mean_queue_wait_ms": queue_wait / len(entries) * 1000.0,
             "mean_device_ms": device / len(entries) * 1000.0,
+            "recorded_total": recorded_total,
         }
 
 

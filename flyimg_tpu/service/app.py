@@ -16,7 +16,9 @@ shutdown), and — debug-gated — /debug/trace (jax.profiler capture),
 /debug/plans (per-plan XLA cost ledger), /debug/flightrecorder (the
 per-launch ring + dump inventory), /debug/profile (arm/list/download
 batch-scoped device-profile captures), /debug/brownout (degradation
-level + pressure components).
+level + pressure components), /debug/autotune (online policy, envelopes,
+decision history), POST /debug/fleet/replicas (dynamic replica-set
+reload).
 
 plus the ``encrypt`` CLI subcommand (reference app.php:93-96):
 
@@ -72,6 +74,11 @@ PARAMS_KEY: web.AppKey[AppParameters] = web.AppKey("params", AppParameters)
 HANDLER_KEY: web.AppKey[ImageHandler] = web.AppKey("handler", ImageHandler)
 METRICS_KEY: web.AppKey = web.AppKey("metrics", object)
 TRACER_KEY: web.AppKey = web.AppKey("tracer", object)
+# the fleet router (dynamic replica-set reload: POST /debug/fleet/replicas
+# and the serve-mode SIGHUP re-read both reach it through this key) and
+# the online policy autotuner (tools/smoke_autotune.py drives it)
+FLEET_KEY: web.AppKey = web.AppKey("fleet", object)
+AUTOTUNER_KEY: web.AppKey = web.AppKey("autotuner", object)
 
 # routes that run the image pipeline get a trace; infrastructure routes
 # (/metrics scrapes, health probes) would only fill the ring with noise
@@ -186,9 +193,14 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
     # the program caches the choice keys into (ops/resample.py;
     # docs/kernels.md). Applied BEFORE any program is built so the first
     # compile already runs the configured variant.
-    from flyimg_tpu.ops.resample import set_kernel_mode
+    from flyimg_tpu.ops.resample import set_auto_band_frac, set_kernel_mode
 
     set_kernel_mode(str(params.by_key("resample_kernel", "dense")))
+    # the auto-mode worth-it threshold is process-wide like the kernel
+    # mode; reset it to the default here so a value TUNED by a previous
+    # app in this process (runtime/autotuner.py) never leaks into a
+    # freshly constructed one
+    set_auto_band_frac(1.0)
     storage = make_storage(params, metrics=metrics)
     import jax
 
@@ -407,7 +419,42 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
         # stage-DAG saturation (worst pool pending/bound): host overload
         # the batcher queues cannot see feeds the same brownout ladder
         host_pipeline=host_pipeline,
+        # followers parked behind remote lease leaders (docs/fleet.md):
+        # a fleet-wide hot-key stampede is load this replica carries
+        # even though its own queues look empty
+        lease_waiters_fn=(
+            (lambda: float(handler.l2lease.waiters))
+            if handler.l2lease is not None else None
+        ),
     )
+    # online policy autotuner (runtime/autotuner.py; docs/autotuning.md):
+    # closes the loop from the observatory (efficiency windows, SLO burn
+    # rates, brownout level, pool snapshots, flight recorder) back to
+    # the serving knobs, within pinned envelopes and behind the SLO-burn
+    # guard rail. Inert (no knob bindings, no metrics, one bool check
+    # per request) with autotune_enable off.
+    from flyimg_tpu.runtime.autotuner import PolicyAutotuner, reuse_signal_fn
+
+    autotuner = PolicyAutotuner.from_params(params, metrics=metrics)
+    if autotuner.enabled:
+        autotuner.register_knobs(
+            batcher=batcher,
+            codec_batcher=codec_batcher,
+            host_pipeline=host_pipeline,
+            handler=handler,
+        )
+        autotuner.attach_signals(
+            metrics=metrics,
+            slo=slo,
+            brownout=brownout,
+            host_pipeline=host_pipeline,
+            flight_recorder=flight_recorder,
+            reuse_fn=(
+                reuse_signal_fn(metrics)
+                if handler.reuse_enable else None
+            ),
+        )
+        autotuner.register_metrics(metrics)
 
     @web.middleware
     async def observability(request: web.Request, handler):
@@ -436,8 +483,12 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
             # transition's brownout.transition span event lands on the
             # request that triggered it (add_event is a no-op with no
             # ambient trace).
+            # The autotuner's guarded tuning step rides the same hook
+            # (rate-limited inside it; one bool check when disabled) so
+            # its autotune.* span events land on the triggering request.
             with tracing.activate(trace):
                 brownout.evaluate()
+                autotuner.evaluate()
             if trace is not None:
                 trace.root.set_attribute("route", route)
                 trace.root.set_attribute("http.method", request.method)
@@ -528,6 +579,8 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
     app[HANDLER_KEY] = handler
     app[METRICS_KEY] = metrics
     app[TRACER_KEY] = tracer
+    app[FLEET_KEY] = fleet
+    app[AUTOTUNER_KEY] = autotuner
 
     # readiness vs liveness: /healthz answers "is the process + device
     # runtime up", /readyz answers "should a load balancer route here".
@@ -1050,6 +1103,61 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
             content_type="application/json",
         )
 
+    async def debug_autotune(_request: web.Request) -> web.Response:
+        """Online autotuner state: live policy vs last-known-good, the
+        envelope table, guard-rail state, and the bounded decision
+        history (runtime/autotuner.py snapshot; docs/autotuning.md)."""
+        import json as _json
+
+        denied = _debug_gate_404()
+        if denied is not None:
+            return denied
+        return web.Response(
+            text=_json.dumps(autotuner.snapshot()),
+            content_type="application/json",
+        )
+
+    async def debug_fleet_replicas(request: web.Request) -> web.Response:
+        """Dynamic replica-set reload (docs/fleet.md "Dynamic replica
+        sets"): swap the rendezvous routing set online. Body:
+        ``{"replicas": [...], "replica_id": "..."}`` (replica_id
+        optional). Routing stays consistent mid-flight: owner resolution
+        reads the set as one reference, so in-flight proxied requests
+        complete against the owner they already resolved."""
+        import json as _json
+
+        denied = _debug_gate_404()
+        if denied is not None:
+            return denied
+        try:
+            body = await request.json()
+        except Exception:
+            return web.Response(
+                status=400, text="body must be JSON"
+            )
+        replicas = body.get("replicas") if isinstance(body, dict) else None
+        if not isinstance(replicas, list) or not all(
+            isinstance(r, str) for r in replicas
+        ):
+            return web.Response(
+                status=400,
+                text='body must be {"replicas": ["http://...", ...], '
+                     '"replica_id": "..."} (replica_id optional)',
+            )
+        self_id = body.get("replica_id")
+        if self_id is not None and not isinstance(self_id, str):
+            return web.Response(status=400, text="replica_id must be a string")
+        applied = fleet.update_replicas(replicas, self_id=self_id)
+        import logging as _logging
+
+        _logging.getLogger("flyimg.fleet").info(
+            "replica set reloaded via /debug/fleet/replicas",
+            extra={"event": "fleet.replicas_reloaded", **applied},
+        )
+        return web.Response(
+            text=_json.dumps(applied), content_type="application/json"
+        )
+
     async def debug_traces_get(request: web.Request) -> web.Response:
         """Full span tree of one kept trace as JSON."""
         import json as _json
@@ -1086,6 +1194,8 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
         "/debug/profile/captures/{name}", debug_profile_download
     )
     app.router.add_get("/debug/brownout", debug_brownout)
+    app.router.add_get("/debug/autotune", debug_autotune)
+    app.router.add_post("/debug/fleet/replicas", debug_fleet_replicas)
     # Route table is config-overridable like the reference's
     # config/routes.yml (RoutesResolver.php); imageSrc uses a catch-all
     # pattern so full URLs (with slashes) work as path parameters — the
@@ -1183,7 +1293,42 @@ def main(argv=None) -> int:
         # multi-host pods: wire the DCN coordination plane before any mesh
         # is built so jax.devices() is the global view (no-op single host)
         initialize_multihost()
-        web.run_app(make_app(params), host=args.host, port=args.port)
+        app = make_app(params)
+        if getattr(args, "params", None):
+            # dynamic replica-set reload on SIGHUP (docs/fleet.md): where
+            # the supervisor can deliver it, re-read the params file and
+            # swap fleet_replicas/fleet_replica_id without a restart —
+            # the same code path as POST /debug/fleet/replicas. Guarded:
+            # platforms without SIGHUP (or embedded loops that own
+            # signal handling) just keep the static boot set.
+            import logging as _logging
+            import signal as _signal
+
+            def _reload_replicas(_signum=None, _frame=None):
+                log = _logging.getLogger("flyimg.fleet")
+                try:
+                    fresh = AppParameters.from_yaml(args.params)
+                    applied = app[FLEET_KEY].update_replicas(
+                        list(fresh.by_key("fleet_replicas", []) or []),
+                        self_id=(
+                            str(fresh.by_key("fleet_replica_id", "") or "")
+                            or None
+                        ),
+                    )
+                    log.info(
+                        "replica set reloaded on SIGHUP",
+                        extra={
+                            "event": "fleet.replicas_reloaded", **applied
+                        },
+                    )
+                except Exception as exc:
+                    log.warning("SIGHUP replica reload failed: %s", exc)
+
+            try:
+                _signal.signal(_signal.SIGHUP, _reload_replicas)
+            except (AttributeError, ValueError, OSError):
+                pass
+        web.run_app(app, host=args.host, port=args.port)
         return 0
     parser.print_help()
     return 1

@@ -964,53 +964,63 @@ class ImageHandler:
                 "l2.lease_wait", key=spec.name,
                 holder=lease.holder(spec.name) or "",
             )
-            waited = 0.0
-            while True:
-                if deadline is not None:
-                    deadline.check("l2_lease")
-                if waited >= lease.wait_cap_s:
-                    self._record_lease("timeout")
-                    if lease_span is not None:
-                        lease_span.set_attribute("lease.role", "timeout")
-                    raise ServiceUnavailableException(
-                        "timed out waiting for the fleet leader rendering "
-                        "this output"
-                    )
-                step = lease.poll_s
-                if deadline is not None:
-                    step = deadline.timeout(step) or step
-                lease._sleep(max(step, 0.001))
-                waited += max(step, 0.001)
-                cached = self.storage.fetch_hedged(spec.name)
-                if cached is not None:
-                    if _cache_entry_valid(cached[0], spec):
-                        self._record_lease("coalesced")
+            # follower-wait accounting: while this thread polls behind a
+            # remote leader it counts in lease.waiters, which the
+            # brownout engine reads as the `l2_lease` pressure component
+            # — a fleet-wide hot-key stampede parks every follower here,
+            # and without this the blocked replica would look IDLE to
+            # its own overload ladder (docs/degradation.md)
+            lease.begin_wait()
+            try:
+                waited = 0.0
+                while True:
+                    if deadline is not None:
+                        deadline.check("l2_lease")
+                    if waited >= lease.wait_cap_s:
+                        self._record_lease("timeout")
                         if lease_span is not None:
-                            lease_span.set_attribute(
-                                "lease.role", "coalesced"
-                            )
-                        return ("serve", cached[0], cached[1].mtime)
-                    # torn under an active lease: a valid-magic,
-                    # garbage-body blob must not serve anywhere in the
-                    # fleet — discard both copies and re-render here
-                    # once the lease frees
-                    tracing.add_event(
-                        "cache.corrupt", key=spec.name,
-                        bytes=len(cached[0]),
-                    )
-                    if self.metrics is not None:
-                        self.metrics.record_cache_corrupt()
-                    try:
-                        self.storage.delete(spec.name)
-                    except Exception:
-                        pass
-                token = lease.acquire(spec.name)
-                if token is not None:
-                    self._record_lease("steal")
-                    tracing.add_event("l2.lease_steal", key=spec.name)
-                    if lease_span is not None:
-                        lease_span.set_attribute("lease.role", "steal")
-                    return ("lead", token)
+                            lease_span.set_attribute("lease.role", "timeout")
+                        raise ServiceUnavailableException(
+                            "timed out waiting for the fleet leader "
+                            "rendering this output"
+                        )
+                    step = lease.poll_s
+                    if deadline is not None:
+                        step = deadline.timeout(step) or step
+                    lease._sleep(max(step, 0.001))
+                    waited += max(step, 0.001)
+                    cached = self.storage.fetch_hedged(spec.name)
+                    if cached is not None:
+                        if _cache_entry_valid(cached[0], spec):
+                            self._record_lease("coalesced")
+                            if lease_span is not None:
+                                lease_span.set_attribute(
+                                    "lease.role", "coalesced"
+                                )
+                            return ("serve", cached[0], cached[1].mtime)
+                        # torn under an active lease: a valid-magic,
+                        # garbage-body blob must not serve anywhere in the
+                        # fleet — discard both copies and re-render here
+                        # once the lease frees
+                        tracing.add_event(
+                            "cache.corrupt", key=spec.name,
+                            bytes=len(cached[0]),
+                        )
+                        if self.metrics is not None:
+                            self.metrics.record_cache_corrupt()
+                        try:
+                            self.storage.delete(spec.name)
+                        except Exception:
+                            pass
+                    token = lease.acquire(spec.name)
+                    if token is not None:
+                        self._record_lease("steal")
+                        tracing.add_event("l2.lease_steal", key=spec.name)
+                        if lease_span is not None:
+                            lease_span.set_attribute("lease.role", "steal")
+                        return ("lead", token)
+            finally:
+                lease.end_wait()
 
     def _record_lease(self, outcome: str) -> None:
         """One cross-replica lease decision; ``outcome`` is the fixed

@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import json
 import logging
+import threading
 import time
 import uuid
 from typing import Callable, Optional
@@ -205,6 +206,31 @@ class L2Lease:
         # one replica can race itself across worker threads, though the
         # process-local single-flight makes that rare)
         self._token = lambda: uuid.uuid4().hex
+        # live follower count (handler._l2_coalesce brackets its poll
+        # loop with begin_wait/end_wait): a replica whose threads are
+        # parked behind a remote leader is LOADED, not idle — the
+        # brownout engine reads this as the `l2_lease` pressure
+        # component (runtime/brownout.py; docs/degradation.md)
+        self._waiters_lock = threading.Lock()
+        self._waiters = 0
+
+    # -- follower-wait accounting ------------------------------------------
+
+    def begin_wait(self) -> None:
+        with self._waiters_lock:
+            self._waiters += 1
+
+    def end_wait(self) -> None:
+        with self._waiters_lock:
+            if self._waiters > 0:
+                self._waiters -= 1
+
+    @property
+    def waiters(self) -> int:
+        """Threads currently blocked polling for a remote leader's
+        artifact — the brownout `l2_lease` pressure numerator."""
+        with self._waiters_lock:
+            return self._waiters
 
     # -- marker IO ---------------------------------------------------------
 

@@ -48,6 +48,13 @@ through:
                         ancestor) or raise (simulated pruned/corrupt
                         ancestor — the handler must fall back to the
                         full from-source pipeline, docs/caching.md)
+    ``autotune.signal`` one autotuner evaluation (runtime/autotuner.py
+                        PolicyAutotuner.evaluate): a plan returning a
+                        dict OVERRIDES the assembled signal window (and
+                        bypasses the evaluation rate limit), so tests
+                        and the CI smoke script exact adjustment /
+                        freeze sequences — the same contract as
+                        ``brownout.signal``
 
 Production cost is one module-level ``None`` check per point (no injector
 installed -> ``fire`` returns ``PASS`` immediately). Tests install a
@@ -95,6 +102,7 @@ KNOWN_POINTS = frozenset({
     "brownout.signal",
     "brownout.refresh",
     "reuse.ancestor",
+    "autotune.signal",
 })
 
 #: sentinel: "no plan fired — run the real code path"

@@ -864,3 +864,204 @@ def test_configured_logging_stamps_replica_on_every_line():
                 logger.addHandler(missing)
         logger.propagate = prev_propagate
         logger.setLevel(prev_level)
+
+
+# ---------------------------------------------------------------------------
+# dynamic replica-set reload (ISSUE 14 satellite; docs/fleet.md
+# "Dynamic replica sets")
+
+
+def test_update_replicas_rehomes_only_changed_keys():
+    router = FleetRouter(REPLICAS, REPLICAS[0])
+    keys = [route_key(f"w_{i}", "https://e.com/a.jpg") for i in range(400)]
+    before = {key: router.owner(key) for key in keys}
+    applied = router.update_replicas(REPLICAS[:-1])
+    assert applied["replicas"] == REPLICAS[:-1]
+    assert applied["enabled"] is True
+    moved = 0
+    for key in keys:
+        after = router.owner(key)
+        if before[key] == REPLICAS[-1]:
+            moved += 1
+            assert after in REPLICAS[:-1]
+        else:
+            assert after == before[key]  # HRW minimal disruption, live
+    assert moved > 0
+
+
+def test_update_replicas_toggles_enabled_and_self_id():
+    router = FleetRouter([], "")
+    assert not router.enabled
+    applied = router.update_replicas(
+        ["http://a/", "http://b"], self_id="http://a"
+    )
+    assert router.enabled
+    assert applied["replica_id"] == "http://a"
+    assert router.replicas == ["http://a", "http://b"]  # normalized
+    router.update_replicas(["http://a"])
+    assert not router.enabled  # one replica = routing off
+    # self_id untouched when not passed
+    assert router.self_id == "http://a"
+
+
+def test_debug_fleet_replicas_endpoint_applies_and_validates(tmp_path):
+    from flyimg_tpu.service.app import FLEET_KEY, make_app
+
+    async def go():
+        params = AppParameters({
+            "tmp_dir": str(tmp_path / "tmp"),
+            "upload_dir": str(tmp_path / "uploads"),
+            "debug": True,
+            "fleet_replicas": ["http://r1", "http://r2"],
+            "fleet_replica_id": "http://r1",
+            "fleet_route": "local",
+        })
+        app = make_app(params)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            resp = await client.post(
+                "/debug/fleet/replicas",
+                json={"replicas": ["http://r1", "http://r2", "http://r3"]},
+            )
+            assert resp.status == 200
+            doc = json.loads(await resp.text())
+            assert doc["replicas"] == [
+                "http://r1", "http://r2", "http://r3"
+            ]
+            assert app[FLEET_KEY].replicas == doc["replicas"]
+            # /debug/perf's fleet section reflects the LIVE set
+            perf = json.loads(await (await client.get("/debug/perf")).text())
+            assert perf["fleet"]["replicas"] == doc["replicas"]
+            # replica_id swap rides the same endpoint
+            resp = await client.post(
+                "/debug/fleet/replicas",
+                json={
+                    "replicas": ["http://r2", "http://r3"],
+                    "replica_id": "http://r2",
+                },
+            )
+            assert json.loads(await resp.text())["replica_id"] == "http://r2"
+            # malformed bodies are 400s, never applied
+            assert (
+                await client.post(
+                    "/debug/fleet/replicas", json={"replicas": "x"}
+                )
+            ).status == 400
+            assert (
+                await client.post(
+                    "/debug/fleet/replicas", json={"replicas": [1, 2]}
+                )
+            ).status == 400
+            assert (
+                await client.post(
+                    "/debug/fleet/replicas", data=b"not json"
+                )
+            ).status == 400
+            assert (
+                await client.post(
+                    "/debug/fleet/replicas",
+                    json={"replicas": ["http://a"], "replica_id": 7},
+                )
+            ).status == 400
+            assert app[FLEET_KEY].replicas == ["http://r2", "http://r3"]
+        finally:
+            await client.close()
+
+    _run(go())
+
+
+def test_debug_fleet_replicas_404_without_debug(tmp_path):
+    from flyimg_tpu.service.app import make_app
+
+    async def go():
+        params = AppParameters({
+            "tmp_dir": str(tmp_path / "tmp"),
+            "upload_dir": str(tmp_path / "uploads"),
+            "debug": False,
+        })
+        app = make_app(params)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            resp = await client.post(
+                "/debug/fleet/replicas", json={"replicas": []}
+            )
+            assert resp.status == 404
+        finally:
+            await client.close()
+
+    _run(go())
+
+
+# ---------------------------------------------------------------------------
+# lease-aware brownout (ISSUE 14 satellite; docs/degradation.md
+# "Lease-aware pressure"): a follower blocked behind a stalled leader
+# counts toward brownout pressure instead of looking idle
+
+
+def test_stalled_leader_follower_counts_toward_brownout(fleet_env):
+    from flyimg_tpu.runtime.brownout import DEGRADED, BrownoutEngine
+
+    (ha, _sa, _ma), (hb, sb, _mb), src, _shared = fleet_env
+    reference = ha.process_image(OPTS, src)
+    name = reference.spec.name
+    sb.delete(name)
+    # a STALLED leader: live foreign marker, artifact never arriving
+    foreign = hb.l2lease.__class__(
+        sb.shared, "replica-stalled", ttl_s=30.0, poll_s=0.01
+    )
+    token = foreign.acquire(name)
+    assert token is not None
+    hb.l2lease.poll_s = 0.02
+    engine = BrownoutEngine(
+        enabled=True, degraded_at=0.4, lease_ref=2.0, eval_interval_s=0.0,
+        metrics=MetricsRegistry(),
+    )
+    engine.attach(lease_waiters_fn=lambda: float(hb.l2lease.waiters))
+    assert engine.evaluate() == 0  # nobody waiting yet
+
+    done = threading.Event()
+
+    def follower():
+        try:
+            hb.process_image(OPTS, src)
+        finally:
+            done.set()
+
+    thread = threading.Thread(target=follower)
+    thread.start()
+    try:
+        deadline = time.monotonic() + 10.0
+        while hb.l2lease.waiters == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert hb.l2lease.waiters == 1
+        level = engine.evaluate()
+        snap = engine.snapshot()
+        # 1 waiter / lease_ref 2.0 = 0.5 pressure -> DEGRADED
+        assert snap["components"]["l2_lease"] == 0.5
+        assert level >= DEGRADED
+    finally:
+        # unstall: publish the artifact and free the lease
+        sb.shared.write(name, reference.content)
+        foreign.release(name, token)
+        done.wait(timeout=30)
+        thread.join(timeout=30)
+    assert hb.l2lease.waiters == 0  # accounting always unwinds
+
+
+def test_lease_component_absent_without_source_or_ref():
+    from flyimg_tpu.runtime.brownout import BrownoutEngine
+
+    engine = BrownoutEngine(
+        enabled=True, eval_interval_s=0.0, metrics=MetricsRegistry(),
+    )
+    assert "l2_lease" not in engine._components()
+    engine.attach(lease_waiters_fn=lambda: 5.0)
+    assert engine._components()["l2_lease"] == 5.0 / 8.0  # default ref
+    zero_ref = BrownoutEngine(
+        enabled=True, lease_ref=0.0, eval_interval_s=0.0,
+        metrics=MetricsRegistry(),
+    )
+    zero_ref.attach(lease_waiters_fn=lambda: 5.0)
+    assert "l2_lease" not in zero_ref._components()

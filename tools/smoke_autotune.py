@@ -1,0 +1,236 @@
+"""CI autotune smoke: boot the app with the online policy autotuner on
+(injectable clock), drive it through synthetic flight-recorder pressure
+and an injected SLO burn, and assert the closed loop end to end
+(docs/autotuning.md):
+
+- synthetic sparse-occupancy launch records (the same per-launch stream
+  the flight recorder and efficiency windows consume) produce exactly
+  ONE bounded, in-envelope adjustment (device flush deadline steps
+  down), visible in /debug/autotune, the live batcher policy, AND the
+  flyimg_autotune_adjustments_total counter;
+- an injected SLO burn past the brownout thresholds freezes tuning:
+  the policy reverts to last-known-good, flyimg_autotune_frozen reads
+  1, and the decision history carries the freeze;
+- a default-off app is byte-clean: no flyimg_autotune_* metrics and a
+  disabled /debug/autotune document.
+
+    JAX_PLATFORMS=cpu python tools/smoke_autotune.py
+
+Exit code 0 = every assertion held. The behavioral matrix (rule
+priorities, revert-on-regression, envelope clamping, torn-read pins)
+lives in tests/test_autotuner.py; this script proves the assembled
+service — middleware evaluation, signal assembly, knob appliers,
+metrics, debug surface — tunes as one system.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import tempfile
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def _require(cond: bool, what: str) -> None:
+    if not cond:
+        print(f"FAIL: {what}", file=sys.stderr)
+        raise SystemExit(1)
+
+
+def _metric_value(text: str, prefix: str) -> float:
+    for line in text.splitlines():
+        if line.startswith(prefix):
+            try:
+                return float(line.rsplit(" ", 1)[1])
+            except ValueError:
+                continue
+    return float("nan")
+
+
+class _Clock:
+    def __init__(self) -> None:
+        self.now = 1000.0
+
+    def __call__(self) -> float:
+        return self.now
+
+
+async def main() -> int:
+    import numpy as np
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from flyimg_tpu.appconfig import AppParameters
+    from flyimg_tpu.codecs import encode
+    from flyimg_tpu.service.app import AUTOTUNER_KEY, METRICS_KEY, make_app
+    from flyimg_tpu.testing import faults
+
+    tmp = tempfile.mkdtemp(prefix="flyimg-autotune-")
+    rng = np.random.default_rng(11)
+    src = os.path.join(tmp, "src.png")
+    with open(src, "wb") as fh:
+        fh.write(
+            encode(rng.integers(0, 255, (64, 96, 3), dtype=np.uint8), "png")
+        )
+
+    clock = _Clock()
+    injected = [faults.PASS]
+    injector = faults.FaultInjector()
+    injector.plan("autotune.signal", lambda **_: injected[0])
+    params = AppParameters(
+        {
+            "tmp_dir": os.path.join(tmp, "t"),
+            "upload_dir": os.path.join(tmp, "u"),
+            "debug": True,
+            "autotune_enable": True,
+            "autotune_interval_s": 5.0,
+            "autotune_clock": clock,
+            "fault_injector": injector,
+            # keep the REAL burn signal calm on the slow CI first-render
+            # (compile-heavy) so only the scripted injection trips the
+            # guard rail
+            "slo_latency_p99_ms": 60000.0,
+        }
+    )
+    app = make_app(params)
+    metrics = app[METRICS_KEY]
+    autotuner = app[AUTOTUNER_KEY]
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        url = f"/upload/w_40,o_jpg,q_85/{src}"
+
+        async def snap() -> dict:
+            return json.loads(await (await client.get("/debug/autotune")).text())
+
+        # 1) warm: one real render seeds the known-good policy
+        warm = await client.get(url)
+        _require(warm.status == 200, f"warm render 200 (got {warm.status})")
+        doc = await snap()
+        _require(doc["enabled"] is True, "autotuner enabled")
+        _require(
+            doc["policy"].get("device.deadline_ms") == 4.0,
+            f"boot deadline policy 4.0 ms (got {doc['policy']})",
+        )
+        boot_policy = dict(doc["policy"])
+
+        # 2) synthetic flight-recorder pressure: a sparse-occupancy
+        #    launch stream (each record is what one device launch feeds
+        #    the flight recorder + efficiency window)
+        for _ in range(24):
+            metrics.record_batch_launch(
+                "device", images=2, capacity=16, queue_wait_s=0.0,
+                device_s=0.01, compile_hit=True,
+            )
+        clock.now += 6.0  # past the adjustment interval
+        await client.get(url)
+        doc = await snap()
+        adjusts = [h for h in doc["history"] if h["action"] == "adjust"]
+        _require(
+            len(adjusts) == 1,
+            f"exactly one adjustment this period (got {adjusts})",
+        )
+        adj = adjusts[0]
+        _require(
+            adj["knob"] == "device.deadline_ms" and adj["to"] == 3.0,
+            f"deadline stepped down one envelope step (got {adj})",
+        )
+        env = doc["envelopes"]["device.deadline_ms"]
+        _require(
+            env["lo"] <= adj["to"] <= env["hi"],
+            f"adjustment in envelope ({adj['to']} in [{env['lo']}, "
+            f"{env['hi']}])",
+        )
+        _require(
+            doc["policy"]["device.deadline_ms"] == 3.0,
+            "live batcher policy carries the tuned deadline",
+        )
+        text = await (await client.get("/metrics")).text()
+        _require(
+            _metric_value(
+                text,
+                'flyimg_autotune_adjustments_total{'
+                'knob="device.deadline_ms",direction="down"}',
+            ) == 1.0,
+            "adjustment counter moved",
+        )
+        _require(
+            _metric_value(text, "flyimg_autotune_frozen") == 0.0,
+            "not frozen while tuning",
+        )
+
+        # 3) injected SLO burn past the brownout thresholds: freeze +
+        #    revert to last-known-good
+        injected[0] = {
+            "controllers": {},
+            "burn_fast_norm": 2.0,
+            "burn_slow_norm": 1.4,
+        }
+        await client.get(url)
+        doc = await snap()
+        _require(doc["frozen"] is True, "guard rail froze tuning")
+        _require(
+            doc["policy"]["device.deadline_ms"]
+            == boot_policy["device.deadline_ms"],
+            f"policy reverted to last-known-good (got {doc['policy']})",
+        )
+        _require(
+            any(h["action"] == "freeze" for h in doc["history"]),
+            "freeze recorded in the decision history",
+        )
+        text = await (await client.get("/metrics")).text()
+        _require(
+            _metric_value(text, "flyimg_autotune_frozen") == 1.0,
+            "flyimg_autotune_frozen gauge reads 1",
+        )
+        _require(
+            not autotuner.snapshot()["pending"],
+            "no pending adjustment survives a freeze",
+        )
+    finally:
+        await client.close()
+
+    # 4) default-off cleanliness: no autotune metrics, disabled document
+    injector2 = faults.FaultInjector()
+    params_off = AppParameters(
+        {
+            "tmp_dir": os.path.join(tmp, "t2"),
+            "upload_dir": os.path.join(tmp, "u2"),
+            "debug": True,
+            "fault_injector": injector2,
+        }
+    )
+    app_off = make_app(params_off)
+    client_off = TestClient(TestServer(app_off))
+    await client_off.start_server()
+    try:
+        warm = await client_off.get(f"/upload/w_40,o_jpg,q_85/{src}")
+        _require(warm.status == 200, "off-app render 200")
+        text = await (await client_off.get("/metrics")).text()
+        _require(
+            "flyimg_autotune" not in text,
+            "no autotune metrics with autotune_enable off",
+        )
+        doc = json.loads(
+            await (await client_off.get("/debug/autotune")).text()
+        )
+        _require(
+            doc["enabled"] is False and not doc["history"],
+            "disabled /debug/autotune document",
+        )
+    finally:
+        await client_off.close()
+
+    print(
+        "autotune smoke OK: one in-envelope adjustment "
+        "(device.deadline_ms 4.0 -> 3.0), SLO-burn freeze + revert, "
+        "default-off clean"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(asyncio.run(main()))
