@@ -146,6 +146,34 @@ SERVER_DEFAULTS: Dict[str, Any] = {
     # bounded batcher drain on graceful shutdown (readiness flips to 503
     # first so load balancers stop routing during the drain)
     "shutdown_drain_timeout_s": 30.0,
+    # --- backend supervisor (runtime/devicesupervisor.py;
+    # docs/resilience.md "Backend failover"). Default OFF: disabled the
+    # batcher carries no supervisor reference, no metrics register, no
+    # threads exist — byte-identical serving ---
+    # master switch: storm detection over classified-transient batch
+    # failures, backend breaker, CPU failover, probe re-promotion
+    "device_supervisor_enable": False,
+    # consecutive transient device-batch failures that trip the breaker
+    # (they must ALSO all land within device_storm_window_s)
+    "device_storm_threshold": 5,
+    # the rate half of storm detection: the threshold failures must fall
+    # inside this window — a slow trickle over hours is per-batch
+    # retry's job, not a storm
+    "device_storm_window_s": 30.0,
+    # background re-probe cadence while failed over (the probe itself is
+    # bounded by backend_probe_timeout_s, the same knob boot uses)
+    "device_probe_interval_s": 5.0,
+    # consecutive clean probes required before re-promotion (hysteresis:
+    # one lucky probe against a flapping tunnel must not re-promote)
+    "device_probe_hysteresis": 2,
+    # bound on the in-flight batch drain at failover/re-promotion;
+    # leftovers are timeout-stamped like a shutdown drain
+    "device_failover_drain_s": 10.0,
+    # fleet health gate (runtime/fleet.py): how long a peer's
+    # device-down verdict re-homes its keys to the next rendezvous
+    # choice (active /readyz probe at most once per TTL per peer, plus
+    # passive detection off relayed cpu-fallback responses); 0 disables
+    "fleet_health_ttl_s": 5.0,
     # --- observability knobs (runtime/tracing.py, runtime/logging.py;
     # docs/observability.md) ---
     # per-request tracing: spans for fetch/decode/batch-wait/device/encode/
@@ -373,6 +401,10 @@ SERVER_DEFAULTS: Dict[str, Any] = {
     # injectable monotonic clock for the autotuner's interval/dwell
     # bookkeeping (runtime/autotuner.py from_params) — same hook style
     "autotune_clock": None,
+    # injectable monotonic clock for the device supervisor's storm
+    # window / probe bookkeeping (runtime/devicesupervisor.py
+    # from_params) — same hook style
+    "device_supervisor_clock": None,
 }
 
 

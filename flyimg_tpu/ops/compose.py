@@ -442,6 +442,27 @@ def program_cache_entries() -> float:
     return float(total)
 
 
+def invalidate_program_caches() -> None:
+    """Drop every cached ``ProgramHandle`` — single-image AND batched.
+
+    The backend-failover path (runtime/devicesupervisor.py): an
+    executable compiled against a dead (or just-replaced) backend must
+    never be called again, so both lru tables clear and the next launch
+    of each program recompiles against whatever backend is live. Handles
+    already held by in-flight launches keep working (they are standalone
+    objects; only the cache mapping clears), and recompiling the SAME
+    key values is clean under the retrace sentinel — re-promotion
+    compiles repeat known values, they do not grow any family's
+    distinct-value count (tools/flylint/retrace_sentinel.py)."""
+    build_program.cache_clear()
+    try:
+        from flyimg_tpu.runtime.batcher import build_batched_program
+
+        build_batched_program.cache_clear()
+    except Exception:  # batcher not imported yet: nothing cached there
+        pass
+
+
 def final_extent(plan: TransformPlan, layout: Layout) -> Tuple[int, int]:
     """Final valid (h, w) of the program output for one image — what a
     padded/bucketed output must be sliced to. Follows the stage order:

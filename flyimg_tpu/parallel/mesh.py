@@ -83,7 +83,8 @@ COMPUTE_PROBE_SNIPPET = (
 )
 
 
-def probe_selected_backend(timeout_s: float, capture_name: bool = False):
+def probe_selected_backend(timeout_s: float, capture_name: bool = False,
+                           env_overrides=None):
     """Run the compute probe in a disposable child against the SAME
     platform selection this process would use (the child re-applies the
     env pin via ensure_env_platform — its own sitecustomize would
@@ -113,11 +114,25 @@ def probe_selected_backend(timeout_s: float, capture_name: bool = False):
         "ensure_env_platform();" + COMPUTE_PROBE_SNIPPET
         + ";import jax;print(jax.default_backend())"
     )
+    # env_overrides (the supervisor's re-probe, runtime/devicesupervisor
+    # .py): probe under a SPECIFIC platform selection instead of this
+    # process's current one — after a forced-CPU failover the parent env
+    # says cpu, but the question is whether the ORIGINAL selection works
+    # again. A None value unsets the variable in the child.
+    child_env = None
+    if env_overrides:
+        child_env = dict(os.environ)
+        for key, value in env_overrides.items():
+            if value is None:
+                child_env.pop(key, None)
+            else:
+                child_env[key] = value
     proc = subprocess.Popen(
         [sys.executable, "-c", probe],
         stdout=subprocess.PIPE if capture_name else subprocess.DEVNULL,
         stderr=subprocess.DEVNULL,
         text=True,
+        env=child_env,
     )
     chunks: list = []
     reader = None
@@ -206,6 +221,63 @@ def _noncpu_plugin_available() -> bool:
     return False
 
 
+def probe_device_backend(
+    timeout_s: float,
+    selection=None,
+) -> Tuple[bool, str]:
+    """THE shared device-backend health probe — used by boot
+    (``ensure_live_backend``) and by the supervisor's re-probe path
+    (``runtime/devicesupervisor.py``), so the two can never drift: a
+    backend that appears AFTER boot (tunnel restored, plugin installed
+    late) is discoverable without a restart because plugin availability
+    (``_noncpu_plugin_available``) is re-evaluated on EVERY call, not
+    frozen at boot.
+
+    Returns ``(ok, detail)``; ``detail`` is one of:
+
+    - ``"cpu"``        — a cpu-only ``JAX_PLATFORMS`` pin: nothing to
+      probe, the selection is trivially healthy
+    - ``"no-plugin"``  — no accelerator plugin is importable right now:
+      the default backend can only be the CPU (boot reads this as
+      "serve cpu, skip the probe"; the supervisor reads it as "the
+      device backend is still absent")
+    - ``"up"``         — the compute probe passed within the deadline
+    - ``"down"``       — it did not
+    - ``"injected"``   — a ``device.backend`` fault plan overrode the
+      verdict (flyimg_tpu/testing/faults.py)
+    - ``"error:<T>"``  — the probe machinery itself raised ``<T>``
+
+    ``selection`` (the supervisor's re-probe after a forced-CPU
+    failover): probe under THIS saved ``{JAX_PLATFORMS, XLA_FLAGS}``
+    mapping instead of the process env — after ``force_cpu_platform``
+    the env says cpu, and trusting it would declare the dead backend
+    healthy on the first probe and flap the replica between CPU and
+    the dead device forever. ``None`` values mean "unset in the child".
+
+    NEVER raises: a probe exception (including an injected one) is a
+    recorded outcome — callers act on the verdict, they do not crash.
+    """
+    from flyimg_tpu.testing import faults
+
+    try:
+        injected = faults.fire("device.backend")
+        if injected is not faults.PASS and injected is not None:
+            return bool(injected), "injected"
+        if selection is not None and "JAX_PLATFORMS" in selection:
+            req = (selection.get("JAX_PLATFORMS") or "").strip()
+        else:
+            req = os.environ.get("JAX_PLATFORMS", "").strip()
+        platforms = {p.strip().lower() for p in req.split(",") if p.strip()}
+        if req and platforms <= {"cpu"}:
+            return True, "cpu"
+        if not req and not _noncpu_plugin_available():
+            return False, "no-plugin"
+        ok = probe_selected_backend(timeout_s, env_overrides=selection)
+        return bool(ok), "up" if ok else "down"
+    except Exception as exc:  # noqa: BLE001 - the contract IS catch-all
+        return False, f"error:{type(exc).__name__}"
+
+
 def ensure_live_backend(timeout_s: float = 75.0) -> str:
     """Boot-time backend selection that cannot hang the server.
 
@@ -233,16 +305,20 @@ def ensure_live_backend(timeout_s: float = 75.0) -> str:
     if req and platforms <= {"cpu"}:
         ensure_env_platform()
         return req
-    if not req and not _noncpu_plugin_available():
-        # the default backend can only be the CPU here — the subprocess
-        # probe (a full python+jax import, seconds of boot time) would
-        # protect nothing (advisor, round 4)
-        return "cpu"
     if timeout_s <= 0:
         if req:
             ensure_env_platform()
         return req_label
-    if probe_selected_backend(timeout_s):
+    # the ONE probe shared with the supervisor's re-probe path
+    # (probe_device_backend): never raises, and re-checks plugin
+    # availability itself
+    ok, detail = probe_device_backend(timeout_s)
+    if detail == "no-plugin":
+        # the default backend can only be the CPU here — the subprocess
+        # probe (a full python+jax import, seconds of boot time) would
+        # protect nothing (advisor, round 4)
+        return "cpu"
+    if ok:
         if req:
             ensure_env_platform()
         return req_label

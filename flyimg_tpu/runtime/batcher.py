@@ -237,6 +237,7 @@ class BatchController:
         executor_wedge_timeout_s: float = 0.0,
         flight_recorder=None,
         profiler=None,
+        supervisor=None,
     ) -> None:
         from flyimg_tpu.runtime.metrics import (
             MetricsRegistry,
@@ -276,6 +277,11 @@ class BatchController:
         # device seconds per program key
         self.flight_recorder = flight_recorder
         self.profiler = profiler
+        # backend supervisor (runtime/devicesupervisor.py): fed one
+        # outcome per launch resolution so it can tell a poison input
+        # (PR-3's job) from a backend-failure STORM (its job). None —
+        # the default, and always the codec controller — is zero-cost.
+        self.supervisor = supervisor
         self._ledger = costledger.get_ledger()
         # admission control: "pending" = submitted and not yet resolved
         # (queued OR executing). When the bound is hit, submit sheds with
@@ -336,6 +342,11 @@ class BatchController:
         # thread is not alive, and without this flag a concurrent
         # submitter would mis-read it as dead and heal AGAIN
         self._executor_pending = False
+        # True while a backend switch is in progress (the device
+        # supervisor's failover/re-promotion): launches hold — a batch
+        # dispatched against a backend being cleared would crash —
+        # while submissions keep queueing normally
+        self._paused = False
         self._spawn_executor().start()
 
     # -- live flush policy (runtime/autotuner.py writes here) ----------
@@ -762,8 +773,123 @@ class BatchController:
         # controller dies — callers (serving shutdown, bulk sweeps) still
         # hold those futures — but a tunnel-hung read must not wedge
         # shutdown forever; leftovers get a TimeoutError and the hung
-        # daemon reader is abandoned.
-        deadline = time.monotonic() + drain_timeout_s
+        # daemon reader is abandoned. ONE drain implementation shared
+        # with the backend-failover path (drain_inflight).
+        self.drain_inflight(
+            drain_timeout_s,
+            message="batcher closed while a device readback hung",
+        )
+
+    def failover_backend(
+        self,
+        mesh,
+        *,
+        drain_timeout_s: float = 10.0,
+        reason: str = "failover",
+    ) -> None:
+        """Rebuild the execution backend ONLINE — the device
+        supervisor's failover/re-promotion write path
+        (runtime/devicesupervisor.py; docs/resilience.md "Backend
+        failover"):
+
+        1. bounded drain of in-flight device batches (they resolve via
+           the normal containment paths; past the budget leftovers are
+           timeout-stamped exactly like a shutdown drain, so no caller
+           strands behind a dead backend),
+        2. the mesh swaps under the lock together with a fresh pipeline
+           semaphore and a replacement executor (queued groups re-home
+           to it; the superseded thread notices and exits — the
+           self-healing machinery, reused),
+        3. BOTH program caches invalidate, so no executable compiled
+           against the old backend is ever called again; every program
+           recompiles lazily against the new one.
+
+        The controller keeps accepting submissions throughout: new
+        groups queue behind the swap and launch on the rebuilt backend.
+        """
+        # validate BEFORE any state mutates: a bad mesh must raise with
+        # the in-flight registry, semaphore, and executor untouched —
+        # not after leftovers were cleared but never timeout-stamped
+        if mesh is not None and "data" not in mesh.axis_names:
+            raise ValueError("batcher mesh needs a 'data' axis")
+        # launches hold for the WHOLE rebuild — owned here, not by the
+        # caller, so the docstring's "submissions keep queueing and
+        # launch on the rebuilt backend" is true for every caller: the
+        # still-live old executor must not dispatch a queued group (and
+        # re-cache an old-backend executable under unchanged keys)
+        # between the invalidation and the swap. Idempotent under the
+        # supervisor's own outer pause: the inner resume below fires
+        # only after the swap is complete, which is exactly when
+        # launches are safe again.
+        self.pause_launches()
+        try:
+            self.drain_inflight(drain_timeout_s)
+            # invalidate BEFORE the replacement executor can run: with
+            # an unchanged mesh the cache keys are identical across the
+            # switch, and a post-start invalidation would let the new
+            # executor hit a stale executable compiled against the old
+            # backend first
+            from flyimg_tpu.ops.compose import invalidate_program_caches
+
+            invalidate_program_caches()
+            replacement = None
+            with self._lock:
+                self.mesh = mesh
+                self._n_devices = (
+                    int(mesh.shape["data"]) if mesh is not None else 1
+                )
+                # a batch wedged against the dead backend never releases
+                # its pipeline slot: abandon the old semaphore with it
+                # (releases land on the captured instance harmlessly,
+                # same as the wedge-heal path)
+                self._inflight = threading.Semaphore(self._pipeline_depth)
+                self._busy_since = None
+                self._busy_owner = None
+                if not self._stop and not self._executor_pending:
+                    replacement = self._spawn_executor()
+        finally:
+            self.resume_launches()
+        self.metrics.record_executor_restart(reason)
+        tracing.add_event(
+            "executor_restart", reason=reason, controller=self.name
+        )
+        if replacement is not None:
+            try:
+                replacement.start()
+            except BaseException:
+                with self._lock:
+                    self._executor_pending = False
+                raise
+
+    def pause_launches(self) -> None:
+        """Hold new device launches (submissions keep queueing) while a
+        backend switch is in progress — the window between clearing the
+        old backend and installing the rebuilt executor must not see a
+        launch against either backend (runtime/devicesupervisor.py).
+        Pair with ``resume_launches`` in a finally."""
+        with self._lock:
+            self._paused = True
+
+    def resume_launches(self) -> None:
+        with self._lock:
+            self._paused = False
+            self._lock.notify_all()
+
+    def drain_inflight(
+        self,
+        drain_timeout_s: float,
+        message: str = "device batch abandoned during backend failover",
+    ) -> None:
+        """THE bounded in-flight drain (one copy: backend failover /
+        re-promotion AND shutdown ``close()`` share it): wait for every
+        in-flight device batch to resolve; past the budget, leftovers
+        are timeout-stamped with ``message`` and deregistered. Exposed
+        separately from ``failover_backend`` because RE-promotion must
+        drain the healthy CPU batches BEFORE the process backend
+        switches — clearing backends under live in-flight arrays is the
+        damage the drain exists to prevent
+        (runtime/devicesupervisor.py)."""
+        deadline = time.monotonic() + max(float(drain_timeout_s), 0.0)
         while time.monotonic() < deadline:
             with self._lock:
                 if not self._inflight_batches:
@@ -773,18 +899,15 @@ class BatchController:
             leftovers = [
                 m for batch in self._inflight_batches for m in batch
             ]
+            # abandoned batches leave the registry NOW: their (possibly
+            # transport-hung) drain threads' removals are membership-
+            # guarded, and close() must not wait a second budget on them
+            self._inflight_batches = []
         for member in leftovers:
             try:
-                member.future.set_exception(
-                    TimeoutError(
-                        "batcher closed while a device readback hung"
-                    )
-                )
+                member.future.set_exception(TimeoutError(message))
             except Exception:
-                # a still-running drain thread can win the race and
-                # resolve the future between our snapshot and here —
-                # that's a success, not a shutdown error
-                pass
+                pass  # a drain thread won the race and resolved it
 
     # ------------------------------------------------------------------
 
@@ -797,12 +920,30 @@ class BatchController:
             group = None
             with self._lock:
                 if self._thread is not me:
-                    return  # superseded by executor self-healing
-                while not self._stop and not self._ready_group():
-                    # wake at the earliest deadline among queued members
-                    timeout = self._next_deadline()
+                    # superseded (self-healing or a backend-failover
+                    # rebuild). Forward the wakeup first: submit()'s
+                    # notify() wakes ONE waiter, and if that waiter is
+                    # this stale thread, exiting without re-notifying
+                    # would leave the LIVE executor parked forever with
+                    # work queued (lost-wakeup; pinned by
+                    # tests/test_device_supervisor.py)
+                    self._lock.notify()
+                    return
+                while not self._stop and (
+                    self._paused or not self._ready_group()
+                ):
+                    # wake at the earliest deadline among queued members.
+                    # While PAUSED, deadlines are irrelevant (launches
+                    # hold regardless) and an already-expired member
+                    # would make _next_deadline() return 0 — a hot spin
+                    # for the whole switch window; resume_launches'
+                    # notify_all is the wake signal instead.
+                    timeout = (
+                        None if self._paused else self._next_deadline()
+                    )
                     self._lock.wait(timeout=timeout)
                     if self._thread is not me:
+                        self._lock.notify()  # pass the baton (see above)
                         return
                 if self._stop and not any(
                     g.members for g in self._groups.values()
@@ -1390,6 +1531,11 @@ class BatchController:
                 dispatch_s=dispatch_s, sync_s=sync_s, device_s=device_s,
                 compile_hit=compile_hit,
             )
+            if self.supervisor is not None:
+                # backend evidence for the device supervisor: a
+                # completed readback means the backend answered, so any
+                # failure storm in progress resets
+                self.supervisor.record_batch_success()
             self._resolve_members(group, members, out)
         except Exception as exc:
             if span_obj is not None and span_obj.duration_s is None:
@@ -1431,6 +1577,12 @@ class BatchController:
         if not live:
             return
         kind = classify_batch_error(exc)
+        if self.supervisor is not None:
+            # one outcome per failed launch, already classified: only
+            # TRANSIENT counts toward a backend-failure storm
+            # (runtime/devicesupervisor.py) — poison stays PR-3's
+            # bisection problem
+            self.supervisor.record_batch_failure(kind)
         span_obj = self._start_batch_span(
             "batch_recovery", len(live), len(live), live
         )
@@ -1481,7 +1633,13 @@ class BatchController:
                 outputs = self._run_members(group, members)
             except Exception as exc:
                 last = exc
-                if classify_batch_error(exc) != TRANSIENT:
+                retry_kind = classify_batch_error(exc)
+                if self.supervisor is not None:
+                    # every failed retry attempt is storm evidence too —
+                    # a dead backend fails batch_retries times per batch,
+                    # and counting each attempt trips the breaker sooner
+                    self.supervisor.record_batch_failure(retry_kind)
+                if retry_kind != TRANSIENT:
                     return exc
                 continue
             self._resolve_members(group, members, outputs)
@@ -1636,4 +1794,8 @@ class BatchController:
             dispatch_s=dispatch_s, sync_s=sync_s, device_s=device_s,
             compile_hit=compile_hit, kind="recovery",
         )
+        if self.supervisor is not None:
+            # a completed recovery launch is backend evidence exactly
+            # like a primary readback
+            self.supervisor.record_batch_success()
         return out

@@ -160,6 +160,7 @@ class BrownoutEngine:
         self._breaker_open_fn: Optional[Callable[[], float]] = None
         self._host_pipeline = None
         self._lease_waiters_fn: Optional[Callable[[], float]] = None
+        self._device_supervisor = None
         self.refresh = RefreshQueue(
             max_pending=refresh_max_pending, metrics=metrics
         )
@@ -207,7 +208,7 @@ class BrownoutEngine:
 
     def attach(self, *, batchers=(), slo=None, inflight_fn=None,
                breaker_open_fn=None, host_pipeline=None,
-               lease_waiters_fn=None) -> None:
+               lease_waiters_fn=None, device_supervisor=None) -> None:
         """Wire the live pressure sources (service/app.py): batch
         controllers (queue depth + efficiency window), the SLO engine
         (burn rates), the inflight-request gauge, the breaker registry's
@@ -223,6 +224,11 @@ class BrownoutEngine:
         self._breaker_open_fn = breaker_open_fn
         self._host_pipeline = host_pipeline
         self._lease_waiters_fn = lease_waiters_fn
+        # the backend supervisor (runtime/devicesupervisor.py): a
+        # replica failed over to CPU rendering carries a fixed pressure
+        # so degradation (and the autotuner's BROWNOUT+ freeze guard
+        # rail) react coherently with the much slower render path
+        self._device_supervisor = device_supervisor
 
     def register_metrics(self, registry) -> None:
         """Render-time gauges on the shared registry: the level an
@@ -284,6 +290,20 @@ class BrownoutEngine:
                 # bound): a saturated decode pool is host overload the
                 # batcher queues can't see (runtime/hostpipeline.py)
                 out["host_stage"] = float(self._host_pipeline.pressure())
+            except Exception:
+                pass
+        if self._device_supervisor is not None:
+            try:
+                # device backend failed over to CPU rendering
+                # (runtime/devicesupervisor.py): a fixed pressure at
+                # exactly the BROWNOUT entry threshold — misses on the
+                # slow CPU path degrade (cheaper plans, stale serving)
+                # but never shed, and the autotuner's guard rail
+                # freezes (docs/degradation.md "Device-loss pressure")
+                out["device_health"] = (
+                    self.brownout_at
+                    if self._device_supervisor.cpu_forced() else 0.0
+                )
             except Exception:
                 pass
         if self._lease_waiters_fn is not None and self.lease_ref > 0:

@@ -37,9 +37,11 @@ behavior pinned by tests/test_fleet.py).
 from __future__ import annotations
 
 import hashlib
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from flyimg_tpu.runtime.resilience import BreakerRegistry, RetryPolicy
+from flyimg_tpu.testing import faults
 
 __all__ = ["FleetRouter", "HOP_HEADER", "route_key", "rendezvous_owner"]
 
@@ -130,6 +132,7 @@ class FleetRouter:
         *,
         mode: str = "proxy",
         proxy_timeout_s: float = 30.0,
+        health_ttl_s: float = 5.0,
         breakers: Optional[BreakerRegistry] = None,
         retry: Optional[RetryPolicy] = None,
         metrics=None,
@@ -138,9 +141,20 @@ class FleetRouter:
         self.self_id = str(self_id or "").rstrip("/")
         self.mode = mode if mode in ("proxy", "local") else "proxy"
         self.proxy_timeout_s = float(proxy_timeout_s)
+        # device-health gating (docs/resilience.md "Backend failover"):
+        # how long a peer's device-down verdict holds — both the active
+        # /readyz probe's and the passive one read off a relayed
+        # X-Flyimg-Degraded: cpu-fallback response. 0 disables the gate
+        # (no probes, no marks — the pre-supervisor routing exactly).
+        self.health_ttl_s = float(health_ttl_s)
         self.breakers = breakers or BreakerRegistry()
         self.retry = retry
         self.metrics = metrics
+        # peer URL -> monotonic expiry of its device-down mark, and the
+        # monotonic time its health was last actively probed (at most
+        # one /readyz round trip per peer per TTL)
+        self._peer_down: Dict[str, float] = {}
+        self._peer_checked: Dict[str, float] = {}
         # lazy httpx.AsyncClient (proxy mode only); typed loose because
         # httpx ships no stubs in this toolchain
         self._client: Optional[Any] = None
@@ -178,6 +192,71 @@ class FleetRouter:
             "enabled": self.enabled,
         }
 
+    # -- peer device health (docs/resilience.md "Backend failover") --------
+
+    def mark_device_down(self, replica: str) -> None:
+        """Record that ``replica`` reported (or served) device-down:
+        for ``health_ttl_s`` its keys re-home to the next rendezvous
+        choice, so proxy traffic routes around its slow CPU renders
+        instead of eating them. Self is never marked — a down replica
+        keeps rendering its own traffic locally."""
+        if self.health_ttl_s <= 0 or replica == self.self_id:
+            return
+        self._peer_down[replica] = time.monotonic() + self.health_ttl_s
+
+    def _device_down(self, replica: str) -> bool:
+        expires = self._peer_down.get(replica)
+        if expires is None:
+            return False
+        if expires <= time.monotonic():
+            # prune on expiry: a transient mark must not leave the dict
+            # non-empty forever (owner()'s zero-cost fast path keys on
+            # emptiness)
+            self._peer_down.pop(replica, None)
+            return False
+        return True
+
+    async def _owner_device_ok(self, owner: str) -> bool:
+        """The health gate consulted before each proxy hop. The check
+        itself is a dict read (a marked-down owner sheds instantly); the
+        ACTIVE ``/readyz`` probe runs OFF the request path — at most one
+        fire-and-forget task per owner per ``health_ttl_s`` — so a
+        supervisor-less or slow-to-answer owner never adds probe latency
+        to a user request. The verdict therefore gates the NEXT request
+        to that owner, not this one; passive detection (the relayed
+        ``cpu-fallback`` header) still marks on the spot."""
+        if self._device_down(owner):
+            return False
+        if self.health_ttl_s <= 0:
+            return True
+        import asyncio
+
+        now = time.monotonic()
+        checked = self._peer_checked.get(owner)
+        if checked is None or now - checked >= self.health_ttl_s:
+            self._peer_checked[owner] = now
+            asyncio.ensure_future(self._probe_owner_health(owner))
+        return True
+
+    async def _probe_owner_health(self, owner: str) -> None:
+        """One background ``/readyz`` probe: a well-formed answer with
+        ``device: down`` marks the owner. Anything else — unreachable,
+        non-JSON, no device field — reads as healthy: the proxy
+        attempt's own failure handling already covers a dead owner, and
+        an owner without a supervisor keeps proxying exactly as
+        before."""
+        try:
+            client = await self._get_client()
+            resp = await client.get(
+                f"{owner}/readyz",
+                timeout=min(2.0, self.proxy_timeout_s),
+            )
+            doc = resp.json()
+        except Exception:
+            return
+        if isinstance(doc, dict) and doc.get("device") == "down":
+            self.mark_device_down(owner)
+
     def owner(self, key: str) -> str:
         # ONE reference read: a concurrent update_replicas (POST
         # endpoint, SIGHUP) swaps the list between this replica's
@@ -186,6 +265,17 @@ class FleetRouter:
         replicas = self.replicas
         if not replicas:
             return self.self_id
+        if self._peer_down:
+            # device-down peers drop out of the rendezvous set: their
+            # keys re-home to the next-highest replica (HRW moves ONLY
+            # the down replica's keys) until the mark expires. Self
+            # always stays — an all-down set must resolve somewhere.
+            live = [
+                r for r in replicas
+                if r == self.self_id or not self._device_down(r)
+            ]
+            if live:
+                replicas = live
         return rendezvous_owner(replicas, key)
 
     def is_owner(self, key: str) -> bool:
@@ -246,6 +336,15 @@ class FleetRouter:
 
         import httpx
 
+        # device-health gate BEFORE the breaker admission: allow() in
+        # HALF_OPEN marks a probe in flight, and shedding after it
+        # without recording an outcome would wedge the breaker's probe
+        # slot forever (no later attempt could ever close it)
+        if not await self._owner_device_ok(owner):
+            # device-down owner: route around its CPU renders — the
+            # caller renders locally now, and owner() re-homes this
+            # key's later requests to a healthy replica for the TTL
+            return None
         breaker = self.breakers.for_host(owner)
         try:
             breaker.allow()
@@ -282,6 +381,23 @@ class FleetRouter:
             if remaining <= 0:
                 break
             try:
+                # fault hook (flyimg_tpu/testing/faults.py): a raising
+                # plan models a transport failure on this hop (retried,
+                # then local fallback); a (status, headers, body) return
+                # stands in for the owner's response
+                injected = faults.fire(
+                    "fleet.proxy", owner=owner, attempt=attempt
+                )
+            except Exception:
+                continue  # injected transport failure: one more try
+            if injected is not faults.PASS and injected is not None:
+                status, inj_headers, body = injected
+                if status in (502, 503, 504):
+                    breaker.record_failure()
+                    return None
+                breaker.record_success()
+                return int(status), dict(inj_headers), bytes(body)
+            try:
                 resp = await client.get(
                     f"{owner}{path_qs}", headers=headers, timeout=remaining
                 )
@@ -291,6 +407,12 @@ class FleetRouter:
                 breaker.record_failure()
                 return None  # sick owner: render locally instead
             breaker.record_success()
+            degraded = resp.headers.get("X-Flyimg-Degraded", "")
+            if "cpu-fallback" in degraded.split(","):
+                # passive health detection: the owner just told us its
+                # renders are CPU-degraded — relay THIS response (it is
+                # valid bytes) but re-home its keys for the TTL
+                self.mark_device_down(owner)
             out_headers = {
                 name: resp.headers[name]
                 for name in _FORWARD_RESPONSE_HEADERS
@@ -309,6 +431,7 @@ class FleetRouter:
             proxy_timeout_s=float(
                 params.by_key("fleet_proxy_timeout_s", 30.0)
             ),
+            health_ttl_s=float(params.by_key("fleet_health_ttl_s", 5.0)),
             breakers=BreakerRegistry.from_params(params, metrics=metrics),
             retry=RetryPolicy.from_params(params, metrics=metrics),
             metrics=metrics,

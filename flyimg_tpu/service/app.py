@@ -16,7 +16,8 @@ shutdown), and — debug-gated — /debug/trace (jax.profiler capture),
 /debug/plans (per-plan XLA cost ledger), /debug/flightrecorder (the
 per-launch ring + dump inventory), /debug/profile (arm/list/download
 batch-scoped device-profile captures), /debug/brownout (degradation
-level + pressure components), /debug/autotune (online policy, envelopes,
+level + pressure components), /debug/device (backend supervisor state:
+breaker, probes, failovers), /debug/autotune (online policy, envelopes,
 decision history), POST /debug/fleet/replicas (dynamic replica-set
 reload).
 
@@ -79,6 +80,9 @@ TRACER_KEY: web.AppKey = web.AppKey("tracer", object)
 # the online policy autotuner (tools/smoke_autotune.py drives it)
 FLEET_KEY: web.AppKey = web.AppKey("fleet", object)
 AUTOTUNER_KEY: web.AppKey = web.AppKey("autotuner", object)
+# the backend supervisor (runtime/devicesupervisor.py): tests and the
+# failover smoke reach the live state machine through this key
+SUPERVISOR_KEY: web.AppKey = web.AppKey("device_supervisor", object)
 
 # routes that run the image pipeline get a trace; infrastructure routes
 # (/metrics scrapes, health probes) would only fill the ring with noise
@@ -273,6 +277,16 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
     from flyimg_tpu.runtime.batcher import containment_params
 
     containment = containment_params(params)
+    # backend supervisor (runtime/devicesupervisor.py; docs/resilience.md
+    # "Backend failover"): watches device-batch outcomes for a
+    # classified-transient failure STORM, trips the backend breaker,
+    # fails the replica over to forced-CPU rendering, and re-promotes
+    # after clean probes. Default off: the batcher carries no supervisor
+    # reference, no metrics register, no threads exist — byte-identical
+    # serving (pinned by tests/test_device_supervisor.py).
+    from flyimg_tpu.runtime.devicesupervisor import DeviceSupervisor
+
+    supervisor = DeviceSupervisor.from_params(params, metrics=metrics)
     batcher = BatchController(
         max_batch=int(params.by_key("batch_max_size", 64)),
         deadline_ms=float(params.by_key("batch_deadline_ms", 4.0)),
@@ -284,8 +298,25 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
         name="device",
         flight_recorder=flight_recorder,
         profiler=profiler,
+        supervisor=supervisor if supervisor.enabled else None,
         **containment,
     )
+    if supervisor.enabled:
+
+        def _device_mesh_factory():
+            # re-queried at every re-promotion: the revived backend's
+            # device list, not boot's
+            local = jax.local_devices()
+            if len(local) > 1:
+                from flyimg_tpu.parallel.mesh import make_mesh
+
+                return make_mesh(devices=local)
+            return None
+
+        supervisor.attach(
+            batcher=batcher, mesh_factory=_device_mesh_factory
+        )
+        supervisor.register_metrics(metrics)
     # host codec work gets its OWN controller/thread: JPEG-miss decode
     # batches (native DecodePool) must not serialize with device launches
     codec_batcher = BatchController(
@@ -359,6 +390,7 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
         storage, params, batcher=batcher, codec_batcher=codec_batcher,
         face_backend=face_backend, metrics=metrics, sp_mesh=sp_mesh,
         brownout=brownout, host_pipeline=host_pipeline,
+        device_supervisor=supervisor if supervisor.enabled else None,
     )
     # state gauges (runtime/metrics.py Gauge): sampled at /metrics render
     inflight = metrics.gauge(
@@ -426,6 +458,10 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
             (lambda: float(handler.l2lease.waiters))
             if handler.l2lease is not None else None
         ),
+        # a replica failed over to CPU rendering carries a fixed
+        # device_health pressure (docs/degradation.md "Device-loss
+        # pressure") so degradation and the autotuner guard rail react
+        device_supervisor=supervisor if supervisor.enabled else None,
     )
     # online policy autotuner (runtime/autotuner.py; docs/autotuning.md):
     # closes the loop from the observatory (efficiency windows, SLO burn
@@ -489,6 +525,10 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
             with tracing.activate(trace):
                 brownout.evaluate()
                 autotuner.evaluate()
+                # the supervisor's failover/re-promotion span events
+                # (queued by its worker threads, which have no ambient
+                # trace) land on this request — one list check when idle
+                supervisor.evaluate()
             if trace is not None:
                 trace.root.set_attribute("route", route)
                 trace.root.set_attribute("http.method", request.method)
@@ -581,6 +621,7 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
     app[TRACER_KEY] = tracer
     app[FLEET_KEY] = fleet
     app[AUTOTUNER_KEY] = autotuner
+    app[SUPERVISOR_KEY] = supervisor
 
     # readiness vs liveness: /healthz answers "is the process + device
     # runtime up", /readyz answers "should a load balancer route here".
@@ -599,6 +640,7 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
     async def _close_batcher(_app):
         draining["flag"] = True  # direct-cleanup callers flip it too
         await fleet.aclose()
+        supervisor.close()
         batcher.close(drain_timeout_s)
         codec_batcher.close(drain_timeout_s)
         host_pipeline.close(drain_timeout_s)
@@ -841,8 +883,16 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
                 text=_json.dumps({"status": "draining"}), status=503,
                 content_type="application/json",
             )
+        doc = {"status": "ok"}
+        if supervisor.enabled:
+            # the device field the fleet health gate reads
+            # (runtime/fleet.py _owner_device_ok): a device-down replica
+            # stays ready (cache hits and CPU-degraded misses still
+            # serve) but peers route owned keys around it. Absent
+            # entirely with the supervisor off — byte-identical body.
+            doc["device"] = "down" if supervisor.cpu_forced() else "ok"
         return web.Response(
-            text=_json.dumps({"status": "ok"}),
+            text=_json.dumps(doc),
             content_type="application/json",
         )
 
@@ -1103,6 +1153,20 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
             content_type="application/json",
         )
 
+    async def debug_device(_request: web.Request) -> web.Response:
+        """Backend supervisor state: breaker/storm bookkeeping, probe
+        history, failover counts (runtime/devicesupervisor.py snapshot;
+        docs/resilience.md "Backend failover")."""
+        import json as _json
+
+        denied = _debug_gate_404()
+        if denied is not None:
+            return denied
+        return web.Response(
+            text=_json.dumps(supervisor.snapshot()),
+            content_type="application/json",
+        )
+
     async def debug_autotune(_request: web.Request) -> web.Response:
         """Online autotuner state: live policy vs last-known-good, the
         envelope table, guard-rail state, and the bounded decision
@@ -1194,6 +1258,7 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
         "/debug/profile/captures/{name}", debug_profile_download
     )
     app.router.add_get("/debug/brownout", debug_brownout)
+    app.router.add_get("/debug/device", debug_device)
     app.router.add_get("/debug/autotune", debug_autotune)
     app.router.add_post("/debug/fleet/replicas", debug_fleet_replicas)
     # Route table is config-overridable like the reference's

@@ -146,6 +146,7 @@ class ImageHandler:
         sp_mesh=None,
         brownout=None,
         host_pipeline=None,
+        device_supervisor=None,
     ) -> None:
         self.storage = storage
         self.params = params
@@ -183,6 +184,12 @@ class ImageHandler:
         # stale-while-revalidate, plan rewriting, miss shedding. None or
         # disabled = today's behavior exactly (docs/degradation.md).
         self.brownout = brownout
+        # backend supervisor (runtime/devicesupervisor.py): while it
+        # reports CPU failover, miss renders tag X-Flyimg-Degraded:
+        # cpu-fallback and are served direct — never cached at the
+        # device-quality keys, which would mask re-promotion. None or
+        # disabled = zero checks, byte-identical serving.
+        self.device_supervisor = device_supervisor
         # derivative-reuse rendering (docs/caching.md; ROADMAP item 2):
         # the per-source variant index + the cache-aware rewriter knobs.
         # Everything is inert with reuse_enable off — no lookups, no
@@ -559,13 +566,25 @@ class ImageHandler:
                     degrade=degrade, degraded_out=modes,
                     render_info=render_info,
                 )
+            # cache-write-time recheck (not just the render-start one in
+            # _process_new): a breaker that trips MID-render re-homes
+            # this request's queued batch onto the rebuilt CPU executor,
+            # and caching those bytes at the device-quality key is
+            # exactly the re-promotion masking the supervisor forbids.
+            # The false positive (a device render finishing just as the
+            # breaker trips) costs one uncached render — the safe side.
+            if self._device_down() and "cpu-fallback" not in modes:
+                modes.append("cpu-fallback")
             if modes:
                 # degraded renders are served direct, never cached: the
                 # cache must only ever hold full-quality bytes, or a
                 # brownout would poison it for a year of CDN max-age
                 modified_at = None
                 for mode in modes:
-                    engine.record_degraded(mode)
+                    # engine is None for brownout-less handlers whose
+                    # only degradation source is the CPU failover tag
+                    if engine is not None:
+                        engine.record_degraded(mode)
                 tracing.add_event(
                     "brownout.degraded_render", key=spec.name,
                     modes=",".join(modes),
@@ -695,6 +714,13 @@ class ImageHandler:
         engine = self.brownout
 
         def refresh() -> None:
+            if self._device_down():
+                # CPU failover (runtime/devicesupervisor.py): a
+                # background refresh would both burn scarce CPU render
+                # capacity and cache a CPU render at the device-quality
+                # key — skip; the stale entry keeps serving and the
+                # refresh happens after re-promotion
+                return
             leader, _flight = self._singleflight.begin(spec.name)
             if not leader:
                 return  # a foreground render is already computing it
@@ -715,6 +741,15 @@ class ImageHandler:
                     payload, options, spec, {}, deadline=deadline,
                     render_info=render_info,
                 )
+                if self._device_down():
+                    # tripped mid-refresh: settle the coalesced waiters
+                    # with the bytes but never cache the CPU render at
+                    # the device-quality key (same write-time recheck as
+                    # the foreground miss path)
+                    self._singleflight.done(
+                        spec.name, result=(content, None, ("cpu-fallback",))
+                    )
+                    return
                 modified_at = self.storage.write(spec.name, content)
                 if self.reuse_enable:
                     self._record_variant(
@@ -1042,6 +1077,12 @@ class ImageHandler:
         if deadline is None:
             return self.device_result_timeout_s
         return deadline.timeout(self.device_result_timeout_s)
+
+    def _device_down(self) -> bool:
+        """Is the backend supervisor serving on CPU failover right now?
+        (runtime/devicesupervisor.py; False without one — zero cost.)"""
+        sup = self.device_supervisor
+        return sup is not None and sup.cpu_forced()
 
     def _record_wedge(self) -> None:
         """EVERY wedged-batcher degradation increments the one counter
@@ -1448,6 +1489,18 @@ class ImageHandler:
         t = time.perf_counter()
         if deadline is not None:
             deadline.check("decode")
+
+        # backend CPU failover (runtime/devicesupervisor.py): tag this
+        # render degraded so it serves direct with X-Flyimg-Degraded:
+        # cpu-fallback and is NEVER cached — a cached CPU render at the
+        # device-quality key would keep serving after re-promotion and
+        # mask it. Snapshot once: the state must not flip mid-render.
+        if (
+            degraded_out is not None
+            and self._device_down()
+            and "cpu-fallback" not in degraded_out
+        ):
+            degraded_out.append("cpu-fallback")
 
         is_animated_gif_out = spec.is_gif
         # clsp_CMYK can only be stored in a JPEG container: refuse HERE,

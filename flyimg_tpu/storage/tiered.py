@@ -43,6 +43,7 @@ import uuid
 from typing import Callable, Optional
 
 from flyimg_tpu.storage.base import Storage
+from flyimg_tpu.testing import faults
 
 LOGGER = "flyimg.fleet"
 
@@ -99,7 +100,18 @@ class TieredStorage(Storage):
         got = self._l1.fetch(name)
         if got is not None:
             return got
-        got = self._l2.fetch(name)
+        try:
+            # fault hook (flyimg_tpu/testing/faults.py l2.storage): a
+            # raising plan models the shared tier going away mid-read —
+            # which must degrade to an L1 miss (single-replica behavior
+            # for this key), never fail the request
+            faults.fire("l2.storage", op="read", name=name)
+            got = self._l2.fetch(name)
+        except Exception as exc:
+            logging.getLogger(LOGGER).warning(
+                "L2 read of %s failed (serving as a miss): %s", name, exc
+            )
+            return None
         if got is None:
             return None
         # promote: derived outputs are content-addressed and their bytes
@@ -125,6 +137,7 @@ class TieredStorage(Storage):
         counted, logged, never a request failure."""
         mtime = self._l1.write(name, data)
         try:
+            faults.fire("l2.storage", op="write", name=name)
             self._l2.write(name, data)
         except Exception as exc:
             if self.metrics is not None:
@@ -234,8 +247,13 @@ class L2Lease:
 
     # -- marker IO ---------------------------------------------------------
 
-    def _read(self, name: str) -> Optional[dict]:
+    def _read(self, name: str, purpose: str = "read") -> Optional[dict]:
         try:
+            # fault hook (flyimg_tpu/testing/faults.py l2.lease):
+            # ``purpose`` distinguishes an ordinary liveness read from
+            # acquire()'s write-confirm read-back — a raising plan on
+            # ``confirm`` exercises the claim-leadership degrade path
+            faults.fire("l2.lease", op=purpose, name=name)
             raw = self.storage.read(lease_name(name))
             doc = json.loads(raw.decode("utf-8"))
         except Exception:
@@ -272,11 +290,12 @@ class L2Lease:
             "ttl_s": self.ttl_s,
         }
         try:
+            faults.fire("l2.lease", op="write", name=name)
             self.storage.write(
                 lease_name(name),
                 json.dumps(marker, sort_keys=True).encode("utf-8"),
             )
-            confirm = self._read(name)
+            confirm = self._read(name, purpose="confirm")
         except Exception as exc:
             # an L2 that cannot hold markers degrades to per-process
             # single-flight: claim leadership locally and render

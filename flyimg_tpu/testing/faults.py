@@ -55,6 +55,30 @@ through:
                         and the CI smoke script exact adjustment /
                         freeze sequences — the same contract as
                         ``brownout.signal``
+    ``device.backend``  one device-backend probe/init attempt
+                        (parallel/mesh.py probe_device_backend — the ONE
+                        helper shared by boot and the supervisor's
+                        re-probe, runtime/devicesupervisor.py): a plan
+                        returning a bool OVERRIDES the probe verdict
+                        (True = backend up, False = dead); a raising
+                        plan models backend init crashing — recorded as
+                        a probe outcome, never a crash
+    ``fleet.proxy``     one proxied owner GET (runtime/fleet.py
+                        FleetRouter.proxy), ctx ``owner``/``attempt``; a
+                        raising plan models a transport failure (the
+                        attempt is retried then falls back to a local
+                        render); a plan returning ``(status, headers,
+                        body)`` stands in for the owner's response
+    ``l2.lease``        one lease-marker operation (storage/tiered.py
+                        L2Lease), ctx ``op`` (``read``/``write``/
+                        ``confirm``) and ``name``; a raising plan models
+                        lease IO failing — acquire degrades to an
+                        uncoalesced render, never a request failure
+    ``l2.storage``      one shared-L2 tier operation (storage/tiered.py
+                        TieredStorage), ctx ``op`` (``read``/``write``)
+                        and ``name``; a raising plan models the shared
+                        tier going away — reads degrade to an L1 miss,
+                        writes to single-replica behavior for that key
 
 Production cost is one module-level ``None`` check per point (no injector
 installed -> ``fire`` returns ``PASS`` immediately). Tests install a
@@ -103,6 +127,10 @@ KNOWN_POINTS = frozenset({
     "brownout.refresh",
     "reuse.ancestor",
     "autotune.signal",
+    "device.backend",
+    "fleet.proxy",
+    "l2.lease",
+    "l2.storage",
 })
 
 #: sentinel: "no plan fired — run the real code path"

@@ -1,22 +1,39 @@
-"""CI chaos smoke: boot the app on CPU, fire concurrent requests whose
-shared device batch contains ONE injected poison member, and assert the
-blast radius held — every innocent request answers 200, the poison request
-alone errors, the isolation counters moved, and /readyz drains cleanly on
-shutdown.
+"""CI chaos smoke + chaos campaign.
+
+Part 1 (the original smoke): boot the app on CPU, fire concurrent
+requests whose shared device batch contains ONE injected poison member,
+and assert the blast radius held — every innocent request answers 200,
+the poison request alone errors, the isolation counters moved, and
+/readyz drains cleanly on shutdown.
+
+Part 2 (the campaign, docs/resilience.md "Proving it"): ONE matrix
+runner sweeping the newer fault points — ``device.backend`` (backend
+probe raises), ``fleet.proxy`` (proxied owner GET fails),
+``l2.lease`` (lease marker IO fails), ``l2.storage`` (shared tier IO
+fails) — × {NORMAL, BROWNOUT}, asserting the standing invariants every
+time:
+
+- no hang past the deadline (every request wrapped in a wait bound),
+- correct 5xx/503 mapping (the faults degrade, they never surface as
+  new user-visible error classes),
+- zero leaked lease markers in the shared tier,
+- admission slots and pipeline semaphores restored (queue-depth gauges
+  return to 0),
+- counters monotone (every ``*_total`` series non-decreasing across
+  the case).
 
     JAX_PLATFORMS=cpu python tools/smoke_chaos.py
 
-Exit code 0 = every assertion held. This is smoke-level (one in-process
-app, one poisoned batch) — the behavioral matrix (bisection cost bounds,
-quarantine TTL, executor self-healing) lives in
-tests/test_batch_isolation.py; this script exists so CI proves the
-wired-together service contains a poison member end to end
-(docs/resilience.md), not just that the batcher unit does.
+Exit code 0 = every assertion held. This is smoke-level — the
+behavioral matrices live in tests/test_batch_isolation.py and
+tests/test_device_supervisor.py; this script exists so CI proves the
+wired-together service degrades end to end, not just that the units do.
 
-Choreography: the executor is wedged on a first innocent request
-(``batcher.execute`` gate), the remaining requests — innocents plus the
-poison — queue into one group while it holds, then the gate opens and the
-group executes as a single poisoned batch that the batcher must bisect.
+Choreography of part 1: the executor is wedged on a first innocent
+request (``batcher.execute`` gate), the remaining requests — innocents
+plus the poison — queue into one group while it holds, then the gate
+opens and the group executes as a single poisoned batch that the
+batcher must bisect.
 """
 
 from __future__ import annotations
@@ -51,7 +68,226 @@ def _metric_value(text: str, name: str) -> float:
     return 0.0
 
 
-async def main() -> int:
+#: every request in the campaign must answer inside this bound — the
+#: "no hang past the deadline" invariant
+REQUEST_TIMEOUT_S = 120.0
+
+#: the campaign's fault points × degradation levels
+CAMPAIGN_POINTS = ("device.backend", "fleet.proxy", "l2.lease", "l2.storage")
+CAMPAIGN_LEVELS = ("normal", "brownout")
+
+
+def _counter_samples(text: str) -> dict:
+    """Every ``*_total`` series in one /metrics scrape — the
+    counters-monotone invariant compares two of these."""
+    out = {}
+    for line in text.splitlines():
+        if line.startswith("#") or " " not in line:
+            continue
+        name, _, value = line.rpartition(" ")
+        if "_total" not in name:
+            continue
+        try:
+            out[name] = float(value)
+        except ValueError:
+            continue
+    return out
+
+
+async def _settled_queue_depths(client) -> None:
+    """Admission slots + pipeline semaphores restored: both controllers'
+    queue-depth gauges must return to 0 once traffic stops."""
+    import asyncio as _asyncio
+
+    for _ in range(100):
+        text = await (await client.get("/metrics")).text()
+        depths = [
+            _metric_value(
+                text, f'flyimg_batcher_queue_depth{{controller="{c}"}}'
+            )
+            for c in ("device", "codec")
+        ]
+        if all(d == 0.0 for d in depths):
+            return
+        await _asyncio.sleep(0.05)
+    _require(False, f"queue depths settled to 0 (saw {depths})")
+
+
+async def _campaign_case(point: str, level: str) -> None:
+    """One campaign cell: a fresh app with ``point``'s fault plan (and,
+    at the brownout level, injected overload pressure), a seeded cache
+    hit, and a couple of misses — then the standing invariants."""
+    import asyncio as _asyncio
+    import glob
+
+    import numpy as np
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from flyimg_tpu.appconfig import AppParameters
+    from flyimg_tpu.codecs import encode
+    from flyimg_tpu.service.app import SUPERVISOR_KEY, make_app
+    from flyimg_tpu.testing import faults
+
+    tmp = tempfile.mkdtemp(prefix=f"flyimg-chaos-{point.replace('.', '-')}-")
+    shared = os.path.join(tmp, "l2")
+    injector = faults.FaultInjector()
+    conf = {
+        "tmp_dir": os.path.join(tmp, "t"),
+        "upload_dir": os.path.join(tmp, "u"),
+        "batch_deadline_ms": 2.0,
+        "request_deadline_s": REQUEST_TIMEOUT_S - 30.0,
+        "resilience_batch_retries": 1,
+        "fault_injector": injector,
+    }
+    if level == "brownout":
+        # injected pressure pins the engine at BROWNOUT (plan rewriting
+        # + SWR active, no shedding) for every evaluation
+        conf["brownout_enable"] = True
+        injector.plan("brownout.signal", lambda **_: 0.9)
+    storm_statuses: set = set()
+    if point == "device.backend":
+        # a dying backend: the first request's launch AND its recovery
+        # retry fail (2 transient outcomes = the storm threshold), the
+        # breaker trips, and every later miss serves on the CPU
+        # fallback; the probe itself RAISES — which must be a recorded
+        # outcome, never a crash
+        conf.update({
+            "device_supervisor_enable": True,
+            "device_storm_threshold": 2,
+            "device_storm_window_s": 60.0,
+            "device_probe_interval_s": 0.2,
+            "device_failover_drain_s": 2.0,
+        })
+        injector.plan(
+            "batcher.drain",
+            faults.fail_n_then_succeed(
+                2, lambda: ConnectionError("chaos: device gone")
+            ),
+        )
+        injector.plan(
+            "device.backend",
+            lambda **_: (_ for _ in ()).throw(
+                RuntimeError("chaos: backend init crashed")
+            ),
+        )
+        storm_statuses = {500, 502}
+    elif point == "fleet.proxy":
+        conf.update({
+            "fleet_replicas": ["http://self-replica", "http://127.0.0.1:9"],
+            "fleet_replica_id": "http://self-replica",
+            "fleet_proxy_timeout_s": 5.0,
+        })
+        injector.plan(
+            "fleet.proxy",
+            lambda **_: (_ for _ in ()).throw(
+                ConnectionError("chaos: hop transport down")
+            ),
+        )
+    elif point == "l2.lease":
+        conf.update({"l2_enable": True, "l2_upload_dir": shared})
+        injector.plan(
+            "l2.lease",
+            lambda **_: (_ for _ in ()).throw(
+                OSError("chaos: lease marker IO down")
+            ),
+        )
+    elif point == "l2.storage":
+        conf.update({"l2_enable": True, "l2_upload_dir": shared})
+        injector.plan(
+            "l2.storage",
+            lambda **_: (_ for _ in ()).throw(
+                OSError("chaos: shared tier down")
+            ),
+        )
+
+    rng = np.random.default_rng(7)
+    src = os.path.join(tmp, "src.png")
+    with open(src, "wb") as fh:
+        fh.write(
+            encode(rng.integers(0, 200, (40, 56, 3), dtype=np.uint8), "png")
+        )
+    app = make_app(AppParameters(conf))
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    label = f"[{point} × {level}]"
+    try:
+        async def bounded_get(path):
+            return await _asyncio.wait_for(
+                client.get(path), timeout=REQUEST_TIMEOUT_S
+            )
+
+        before = _counter_samples(
+            await (await client.get("/metrics")).text()
+        )
+        if point == "device.backend":
+            # the storm-trigger request may 5xx (retries exhausted
+            # against the "dying device") — that IS the correct mapping
+            resp = await bounded_get(f"/upload/w_31,o_png/{src}")
+            _require(
+                resp.status == 200 or resp.status in storm_statuses,
+                f"{label} storm request mapped 200/5xx "
+                f"(got {resp.status})",
+            )
+            supervisor = app[SUPERVISOR_KEY]
+            for _ in range(200):
+                if supervisor.cpu_forced():
+                    break
+                await _asyncio.sleep(0.05)
+            _require(
+                supervisor.cpu_forced(),
+                f"{label} storm tripped the backend breaker",
+            )
+        # seed one cached key, then re-request it: hits must serve 200
+        # under EVERY fault (the seed render itself must also serve)
+        seed = await bounded_get(f"/upload/w_33,o_png/{src}")
+        _require(
+            seed.status == 200,
+            f"{label} seed miss served (got {seed.status})",
+        )
+        hit = await bounded_get(f"/upload/w_33,o_png/{src}")
+        _require(
+            hit.status == 200,
+            f"{label} cache hit served (got {hit.status})",
+        )
+        miss = await bounded_get(f"/upload/w_34,o_png/{src}")
+        _require(
+            miss.status == 200,
+            f"{label} degraded miss served (got {miss.status})",
+        )
+        if point == "device.backend":
+            _require(
+                "cpu-fallback"
+                in miss.headers.get("X-Flyimg-Degraded", "").split(","),
+                f"{label} miss tagged cpu-fallback",
+            )
+        # standing invariants
+        _require(
+            not glob.glob(os.path.join(shared, "**", "*.lease"),
+                          recursive=True),
+            f"{label} zero leaked lease markers",
+        )
+        await _settled_queue_depths(client)
+        after = _counter_samples(
+            await (await client.get("/metrics")).text()
+        )
+        for name, value in before.items():
+            _require(
+                after.get(name, 0.0) >= value,
+                f"{label} counter {name} monotone "
+                f"({value} -> {after.get(name)})",
+            )
+        print(f"chaos campaign OK {label}")
+    finally:
+        await client.close()
+
+
+async def campaign() -> None:
+    for point in CAMPAIGN_POINTS:
+        for level in CAMPAIGN_LEVELS:
+            await _campaign_case(point, level)
+
+
+async def poison_smoke() -> int:
     import numpy as np
     from aiohttp.test_utils import TestClient, TestServer
 
@@ -185,6 +421,16 @@ async def main() -> int:
     finally:
         gate.set()
         await client.close()
+
+
+async def main() -> int:
+    rc = await poison_smoke()
+    if rc != 0:
+        return rc
+    # each campaign case installs its own injector; the poison smoke's
+    # app cleared the shared hook on close, so cases start clean
+    await campaign()
+    return 0
 
 
 if __name__ == "__main__":
