@@ -348,6 +348,36 @@ SERVER_DEFAULTS: Dict[str, Any] = {
     # `l2_lease` brownout component reads 1.0 — a fleet-wide hot-key
     # stampede registers as load instead of looking idle
     "brownout_lease_ref": 8.0,
+    # --- elastic fleet membership (runtime/membership.py;
+    # docs/fleet.md "Membership and elasticity"). Default OFF: serving
+    # is byte-identical — no markers, no heartbeat thread, no metrics,
+    # and fleet_replicas/SIGHUP stay authoritative (pinned by
+    # tests/test_fleet_membership.py) ---
+    # replicas announce/heartbeat via TTL'd markers on the shared L2
+    # tier and the watcher drives FleetRouter.update_replicas — the
+    # static fleet_replicas list becomes the boot-time hint only, and
+    # the manual escape hatches (POST /debug/fleet/replicas, SIGHUP)
+    # are rejected to prevent split-brain. Requires l2_enable with a
+    # listable shared backend (l2_storage_system: local)
+    "fleet_membership_enable": False,
+    # marker expiry: a crashed replica drops from every peer's
+    # rendezvous set within this long of its last heartbeat (only ITS
+    # keys re-home); must comfortably exceed the heartbeat cadence
+    "fleet_membership_ttl_s": 15.0,
+    # heartbeat/watch cadence: each beat renews this replica's marker,
+    # re-lists the live set, and piggybacks warm-start publication
+    "fleet_membership_heartbeat_s": 5.0,
+    # --- fleet-wide warm start (runtime/warmstart.py; docs/fleet.md).
+    # Default OFF: no recorder installed, no manifests read/written,
+    # byte-identical serving ---
+    # record the program identities this replica compiles, publish them
+    # (and the autotuner's known-good policy table) as digest-stamped
+    # manifests on the shared tier, and AOT-precompile a peer manifest
+    # at boot so a scale-out replica serves at speed
+    "warmstart_enable": False,
+    # ceiling on manifest size (entries recorded per replica AND seeded
+    # per boot) — oldest entries trim first on publish
+    "warmstart_max_entries": 64,
     # --- online policy autotuner (runtime/autotuner.py;
     # docs/autotuning.md). Default OFF: with autotune_enable false the
     # serving path is byte-for-byte today's behavior — no knob writes,
@@ -405,6 +435,10 @@ SERVER_DEFAULTS: Dict[str, Any] = {
     # window / probe bookkeeping (runtime/devicesupervisor.py
     # from_params) — same hook style
     "device_supervisor_clock": None,
+    # injectable WALL clock for membership marker timestamps
+    # (runtime/membership.py from_params) so TTL/skew tests never sleep
+    # — wall, not monotonic: marker ages are compared across processes
+    "fleet_membership_clock": None,
 }
 
 

@@ -390,6 +390,14 @@ def build_program(
         "single", in_shape, resample_out, pad_canvas, pad_offset, plan,
         band_taps,
     )
+    # fleet warm start (runtime/warmstart.py): note this program's
+    # identity for the shared manifest — inside the lru body, so once
+    # per distinct program; a no-op unless a recorder is installed
+    from flyimg_tpu.runtime import warmstart
+
+    warmstart.record_single(
+        in_shape, resample_out, pad_canvas, pad_offset, plan, band_taps
+    )
     return ProgramHandle(
         jax.jit(make_program_fn(
             resample_out, pad_canvas, pad_offset, plan,

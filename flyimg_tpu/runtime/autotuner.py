@@ -550,6 +550,47 @@ class PolicyAutotuner:
         self._flight_recorder = flight_recorder
         self._reuse_fn = reuse_fn
 
+    def known_good(self) -> Dict[str, float]:
+        """The last-known-good policy table (what a freeze reverts to;
+        what fleet warm start publishes for peers to adopt)."""
+        with self._lock:
+            return dict(self._known_good)
+
+    def seed_known_good(self, table: Dict[str, float]) -> Dict[str, float]:
+        """Fleet warm start (runtime/warmstart.py): adopt a peer-
+        published known-good policy table at boot, BEFORE any traffic.
+        Only knobs this replica actually bound apply (a foreign table
+        may name layers this config doesn't run), and every value is
+        clamped to THIS replica's envelopes — a peer can never push a
+        knob outside the bounds an operator could have shipped by
+        hand. Applied values become this replica's known-good floor,
+        so a later guard-rail freeze reverts to the seeded policy, not
+        to cold defaults. Returns the applied subset."""
+        applied: Dict[str, float] = {}
+        if not self.enabled:
+            return applied
+        with self._lock:
+            now = self._clock()
+            for name in sorted(table or {}):
+                binding = self._knobs.get(name)
+                if binding is None:
+                    continue
+                try:
+                    value = binding.envelope.clamp(float(table[name]))
+                    frm = float(binding.getter())
+                    if value != frm:
+                        binding.applier(value)
+                except Exception:
+                    continue  # one bad knob never blocks the rest
+                self._known_good[name] = value
+                applied[name] = value
+                if value != frm:
+                    self._record_locked(
+                        "seed", name, frm, value, "seed",
+                        "warm-start known-good table", now, None,
+                    )
+        return applied
+
     def register_metrics(self, registry) -> None:
         """The guard-rail gauge. No-op when disabled: with
         ``autotune_enable`` off the /metrics surface must be

@@ -157,6 +157,16 @@ def build_batched_program(
         tuple(mesh.shape.items()) if mesh is not None else None,
         band_taps,
     )
+    # fleet warm start (runtime/warmstart.py): note this program's
+    # identity for the shared manifest — the mesh stays out (a seeding
+    # replica compiles against its OWN topology); a no-op unless a
+    # recorder is installed
+    from flyimg_tpu.runtime import warmstart
+
+    warmstart.record_batched(
+        batch_size, in_shape, resample_out, pad_canvas, pad_offset,
+        plan, rotate_dynamic, mesh is not None, band_taps,
+    )
     return ProgramHandle(
         jitted,
         key,

@@ -171,16 +171,19 @@ class FleetRouter:
         self,
         replicas: List[str],
         self_id: Optional[str] = None,
+        source: str = "manual",
     ) -> Dict[str, object]:
         """Swap the replica set online (docs/fleet.md "Dynamic replica
         sets"): the debug-gated ``POST /debug/fleet/replicas`` endpoint
-        and the serve-mode SIGHUP config re-read both land here. The new
-        list replaces ``self.replicas`` as ONE reference swap, so every
-        ``owner()`` call routes against either the old set or the new —
-        never a half-updated one — and requests already proxying against
-        an old owner complete normally (they captured the owner URL
-        before the swap; HRW re-homes only the changed replicas' keys).
-        Returns the applied routing snapshot."""
+        and the serve-mode SIGHUP config re-read both land here — and so
+        does the membership watcher (runtime/membership.py, ``source=
+        "membership"``) on every live-set change. The new list replaces
+        ``self.replicas`` as ONE reference swap, so every ``owner()``
+        call routes against either the old set or the new — never a
+        half-updated one — and requests already proxying against an old
+        owner complete normally (they captured the owner URL before the
+        swap; HRW re-homes only the changed replicas' keys). Returns the
+        applied routing snapshot."""
         new = [str(r).rstrip("/") for r in replicas if str(r)]
         if self_id is not None:
             self.self_id = str(self_id).rstrip("/")
@@ -190,6 +193,7 @@ class FleetRouter:
             "replica_id": self.self_id,
             "mode": self.mode,
             "enabled": self.enabled,
+            "source": source,
         }
 
     # -- peer device health (docs/resilience.md "Backend failover") --------

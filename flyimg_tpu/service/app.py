@@ -83,6 +83,9 @@ AUTOTUNER_KEY: web.AppKey = web.AppKey("autotuner", object)
 # the backend supervisor (runtime/devicesupervisor.py): tests and the
 # failover smoke reach the live state machine through this key
 SUPERVISOR_KEY: web.AppKey = web.AppKey("device_supervisor", object)
+# elastic fleet membership (runtime/membership.py): the SIGHUP handler
+# and the split-brain guard on /debug/fleet/replicas reach it here
+MEMBERSHIP_KEY: web.AppKey = web.AppKey("membership", object)
 
 # routes that run the image pipeline get a trace; infrastructure routes
 # (/metrics scrapes, health probes) would only fill the ring with noise
@@ -491,6 +494,41 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
             ),
         )
         autotuner.register_metrics(metrics)
+    # fleet-wide warm start (runtime/warmstart.py; docs/fleet.md
+    # "Membership and elasticity"): seed this replica's program cache
+    # and policy table from peer-published manifests on the SHARED tier
+    # BEFORE the first request, then record/publish what this replica
+    # compiles. Seeding is synchronous here by design — a replica that
+    # announces itself ready has already absorbed its compile storm.
+    # Inert (no recorder, no manifest IO, no metrics) with
+    # warmstart_enable off.
+    from flyimg_tpu.runtime import warmstart as warmstart_mod
+
+    warmstart = warmstart_mod.WarmStartCache.from_params(
+        params, storage=storage.shared, metrics=metrics
+    )
+    if warmstart.enabled:
+        warmstart.install()
+        warmstart.seed_policy(autotuner)
+        warmstart.seed_programs(mesh=mesh)
+    # elastic fleet membership (runtime/membership.py; docs/fleet.md):
+    # announce/heartbeat/watch over TTL'd markers on the shared tier,
+    # feeding FleetRouter.update_replicas so joins/leaves/crashes
+    # re-home only the moved keys within one TTL. A device-down replica
+    # heartbeats as degraded (the router's health gate routes around
+    # it); the warm-start manifests publish on the membership beat.
+    # Inert (no markers, no thread, no metrics) with
+    # fleet_membership_enable off.
+    from flyimg_tpu.runtime.membership import FleetMembership
+
+    membership = FleetMembership.from_params(
+        params,
+        storage=storage.shared,
+        router=fleet,
+        supervisor=supervisor if supervisor.enabled else None,
+        warmstart=warmstart if warmstart.enabled else None,
+        metrics=metrics,
+    )
 
     @web.middleware
     async def observability(request: web.Request, handler):
@@ -622,6 +660,7 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
     app[FLEET_KEY] = fleet
     app[AUTOTUNER_KEY] = autotuner
     app[SUPERVISOR_KEY] = supervisor
+    app[MEMBERSHIP_KEY] = membership
 
     # readiness vs liveness: /healthz answers "is the process + device
     # runtime up", /readyz answers "should a load balancer route here".
@@ -632,6 +671,11 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
 
     async def _begin_drain(_app):
         draining["flag"] = True
+        # graceful scale-in, phase 1: flip the membership marker to
+        # draining so peers stop routing owned keys here on their next
+        # watch beat, while the bounded drains below finish in-flight
+        # work. No-op with membership off.
+        membership.begin_drain()
 
     app.on_shutdown.append(_begin_drain)
 
@@ -639,17 +683,34 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
 
     async def _close_batcher(_app):
         draining["flag"] = True  # direct-cleanup callers flip it too
+        membership.begin_drain()  # direct-cleanup callers drain too
         await fleet.aclose()
         supervisor.close()
         batcher.close(drain_timeout_s)
         codec_batcher.close(drain_timeout_s)
         host_pipeline.close(drain_timeout_s)
+        # phase 2: the drains finished — publish what this replica
+        # compiled for the next scale-out, release the membership
+        # marker, and disarm the process-wide recorder (like
+        # faults.clear below: process-global state must not leak
+        # across apps/tests)
+        if warmstart.enabled:
+            warmstart.maybe_publish()
+            warmstart_mod.uninstall()
+        membership.close()
         if injector is not None:
             from flyimg_tpu.testing import faults
 
             faults.clear()
 
     app.on_cleanup.append(_close_batcher)
+
+    if membership.enabled:
+
+        async def _start_membership(_app):
+            membership.start()
+
+        app.on_startup.append(_start_membership)
 
     # automatic cache budget: prune least-recently-modified outputs in the
     # background when `cache_max_bytes` is set (local storage only — S3 /
@@ -891,6 +952,11 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
             # serve) but peers route owned keys around it. Absent
             # entirely with the supervisor off — byte-identical body.
             doc["device"] = "down" if supervisor.cpu_forced() else "ok"
+        if membership.enabled:
+            # the elastic drain walk (docs/fleet.md): ready ->
+            # draining (503 above, via on_shutdown) -> gone. Absent
+            # entirely with membership off — byte-identical body.
+            doc["members"] = int(membership.member_count())
         return web.Response(
             text=_json.dumps(doc),
             content_type="application/json",
@@ -1181,18 +1247,53 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
             content_type="application/json",
         )
 
+    async def debug_fleet(_request: web.Request) -> web.Response:
+        """Elastic membership state (runtime/membership.py snapshot +
+        warm-start stats; docs/fleet.md "Membership and elasticity"):
+        self status, the applied live set, every readable marker with
+        its expiry verdict, heartbeat failures, and the warm-start
+        seed/publish accounting."""
+        import json as _json
+
+        denied = _debug_gate_404()
+        if denied is not None:
+            return denied
+        doc = membership.snapshot()
+        doc["warmstart"] = warmstart.snapshot()
+        return web.Response(
+            text=_json.dumps(doc), content_type="application/json"
+        )
+
     async def debug_fleet_replicas(request: web.Request) -> web.Response:
         """Dynamic replica-set reload (docs/fleet.md "Dynamic replica
         sets"): swap the rendezvous routing set online. Body:
         ``{"replicas": [...], "replica_id": "..."}`` (replica_id
         optional). Routing stays consistent mid-flight: owner resolution
         reads the set as one reference, so in-flight proxied requests
-        complete against the owner they already resolved."""
+        complete against the owner they already resolved. REJECTED
+        while elastic membership is active — a manual swap would fight
+        the watcher's next beat (split-brain; docs/fleet.md)."""
         import json as _json
 
         denied = _debug_gate_404()
         if denied is not None:
             return denied
+        if membership.active:
+            import logging as _logging
+
+            _logging.getLogger("flyimg.fleet").warning(
+                "manual replica-set reload rejected: elastic "
+                "membership owns the replica set",
+                extra={"event": "fleet.manual_reload_rejected",
+                       "source": "debug_endpoint"},
+            )
+            return web.Response(
+                status=400,
+                text="replica set is managed by fleet membership "
+                     "(fleet_membership_enable is on); a manual swap "
+                     "would be overwritten by the watcher's next beat "
+                     "— stop the replica or disable membership instead",
+            )
         try:
             body = await request.json()
         except Exception:
@@ -1260,6 +1361,7 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
     app.router.add_get("/debug/brownout", debug_brownout)
     app.router.add_get("/debug/device", debug_device)
     app.router.add_get("/debug/autotune", debug_autotune)
+    app.router.add_get("/debug/fleet", debug_fleet)
     app.router.add_post("/debug/fleet/replicas", debug_fleet_replicas)
     # Route table is config-overridable like the reference's
     # config/routes.yml (RoutesResolver.php); imageSrc uses a catch-all
@@ -1371,6 +1473,17 @@ def main(argv=None) -> int:
 
             def _reload_replicas(_signum=None, _frame=None):
                 log = _logging.getLogger("flyimg.fleet")
+                if app[MEMBERSHIP_KEY].active:
+                    # split-brain guard (docs/fleet.md "Membership and
+                    # elasticity"): while the watcher owns the replica
+                    # set a SIGHUP swap would fight its next beat
+                    log.warning(
+                        "SIGHUP replica reload rejected: elastic "
+                        "membership owns the replica set",
+                        extra={"event": "fleet.manual_reload_rejected",
+                               "source": "sighup"},
+                    )
+                    return
                 try:
                     fresh = AppParameters.from_yaml(args.params)
                     applied = app[FLEET_KEY].update_replicas(

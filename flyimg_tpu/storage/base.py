@@ -172,6 +172,14 @@ class Storage(abc.ABC):
         override with a single native call (os.stat / S3 HeadObject)."""
         return StorageStat() if self.has(name) else None
 
+    def list_names(self, prefix: str):
+        """Object names starting with ``prefix``, or None when the backend
+        cannot enumerate (the capability-absent signal: fleet membership
+        — runtime/membership.py — gates itself off rather than guessing
+        at liveness it cannot observe). Backends with a native listing
+        primitive (os.scandir / S3 ListObjectsV2) override."""
+        return None
+
     def fetch(self, name: str) -> Optional[tuple]:
         """(bytes, StorageStat) in ONE round trip, or None when absent —
         the cache-hit serving path (existence + bytes + mtime together;

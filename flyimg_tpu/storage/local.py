@@ -77,6 +77,23 @@ class LocalStorage(Storage):
         except OSError:
             return None
 
+    def list_names(self, prefix: str):
+        """One scandir pass; in-flight ``.part`` halves stay invisible, so
+        a listed name is always a completed atomic write."""
+        names = []
+        try:
+            with os.scandir(self.root) as it:
+                for entry in it:
+                    if (
+                        entry.is_file()
+                        and entry.name.startswith(prefix)
+                        and not entry.name.endswith(".part")
+                    ):
+                        names.append(entry.name)
+        except OSError:
+            return []
+        return names
+
     def fetch(self, name: str):
         def _fetch():
             with open(self._path(name), "rb") as fh:

@@ -50,10 +50,21 @@ LOGGER = "flyimg.fleet"
 #: suffix of the lease marker object a leader writes next to the artifact
 LEASE_SUFFIX = ".lease"
 
+#: fleet-membership heartbeat markers (runtime/membership.py) live on the
+#: same shared tier under a reserved flat prefix/suffix pair — flat
+#: because LocalStorage basenames every object name
+MEMBER_PREFIX = "fleet-member--"
+MEMBER_SUFFIX = ".member"
+
 
 def lease_name(name: str) -> str:
     """Storage object name of the lease marker guarding ``name``."""
     return f"{name}{LEASE_SUFFIX}"
+
+
+def member_name(slug: str) -> str:
+    """Storage object name of the membership marker for a replica slug."""
+    return f"{MEMBER_PREFIX}{slug}{MEMBER_SUFFIX}"
 
 
 class TieredStorage(Storage):

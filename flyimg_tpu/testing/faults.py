@@ -79,6 +79,19 @@ through:
                         and ``name``; a raising plan models the shared
                         tier going away — reads degrade to an L1 miss,
                         writes to single-replica behavior for that key
+    ``fleet.member``    one membership-marker operation
+                        (runtime/membership.py FleetMembership), ctx
+                        ``op`` (``read``/``write``/``confirm``/``list``/
+                        ``delete``), ``name``, ``replica``; a raising
+                        plan models marker IO failing — heartbeats count
+                        a failure and retry next beat, the watcher keeps
+                        the previous live set, requests never fail
+    ``warmstart.cache`` one warm-start manifest operation
+                        (runtime/warmstart.py WarmStartCache), ctx
+                        ``op`` (``read``/``write``) and ``name``; a
+                        raising plan models the shared tier refusing the
+                        manifest — seeding degrades to a cold boot,
+                        publishing retries on a later beat
 
 Production cost is one module-level ``None`` check per point (no injector
 installed -> ``fire`` returns ``PASS`` immediately). Tests install a
@@ -131,6 +144,8 @@ KNOWN_POINTS = frozenset({
     "fleet.proxy",
     "l2.lease",
     "l2.storage",
+    "fleet.member",
+    "warmstart.cache",
 })
 
 #: sentinel: "no plan fired — run the real code path"

@@ -344,38 +344,67 @@ def _free_port() -> int:
 # multi-replica fleet A/B (--replicas N; docs/fleet.md "Measurement")
 
 
-def _spawn_fleet(n: int, root: str, *, fleet_on: bool, mode: str = "proxy"):
+#: churn-leg membership timing — short enough that one bench run sees
+#: crash detection and re-homing, long enough to stay off the fast path
+CHURN_TTL_S = 3.0
+CHURN_BEAT_S = 0.5
+
+
+def _spawn_replica(i: int, port: int, root: str, urls: list, *,
+                   fleet_on: bool, mode: str, membership: bool = False,
+                   warmstart: bool = False):
+    """One fleet member process. Split out of _spawn_fleet so the churn
+    leg can restart a killed replica on its original port with warm
+    start toggled per restart."""
+    url = f"http://127.0.0.1:{port}"
+    shared = os.path.join(root, "shared-l2")
+    replica_root = os.path.join(root, f"replica-{i}")
+    os.makedirs(replica_root, exist_ok=True)
+    params_path = os.path.join(replica_root, "params.yml")
+    with open(params_path, "w") as fh:
+        fh.write("debug: true\n")
+        fh.write("reuse_enable: true\n")
+        fh.write(f"upload_dir: {os.path.join(replica_root, 'out')}\n")
+        fh.write(f"tmp_dir: {os.path.join(replica_root, 'tmp')}\n")
+        fh.write(f"fleet_replica_id: {url}\n")
+        if fleet_on:
+            fh.write(f"fleet_replicas: {json.dumps(urls)}\n")
+            fh.write(f"fleet_route: {mode}\n")
+            fh.write("l2_enable: true\n")
+            fh.write(f"l2_upload_dir: {shared}\n")
+        if membership:
+            fh.write("fleet_membership_enable: true\n")
+            fh.write(f"fleet_membership_ttl_s: {CHURN_TTL_S}\n")
+            fh.write(f"fleet_membership_heartbeat_s: {CHURN_BEAT_S}\n")
+        if warmstart:
+            fh.write("warmstart_enable: true\n")
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "flyimg_tpu.service.app", "serve",
+            "--port", str(port), "--params", params_path,
+        ],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+
+
+def _spawn_fleet(n: int, root: str, *, fleet_on: bool, mode: str = "proxy",
+                 membership: bool = False):
     """Spawn N app processes as one fleet. ``fleet_on`` arms rendezvous
     routing + the shared L2 + lease; off = N isolated replicas behind a
     dumb round-robin (today's load-balancer story, the control leg).
-    Returns (procs, urls, shared_dir)."""
+    ``membership`` (the --churn prerequisite) arms heartbeat markers +
+    warm start on top. Returns (procs, urls)."""
     ports = [_free_port() for _ in range(n)]
     urls = [f"http://127.0.0.1:{p}" for p in ports]
-    shared = os.path.join(root, "shared-l2")
-    procs = []
-    for i, (port, url) in enumerate(zip(ports, urls)):
-        replica_root = os.path.join(root, f"replica-{i}")
-        os.makedirs(replica_root, exist_ok=True)
-        params_path = os.path.join(replica_root, "params.yml")
-        with open(params_path, "w") as fh:
-            fh.write("debug: true\n")
-            fh.write("reuse_enable: true\n")
-            fh.write(f"upload_dir: {os.path.join(replica_root, 'out')}\n")
-            fh.write(f"tmp_dir: {os.path.join(replica_root, 'tmp')}\n")
-            fh.write(f"fleet_replica_id: {url}\n")
-            if fleet_on:
-                fh.write(f"fleet_replicas: {json.dumps(urls)}\n")
-                fh.write(f"fleet_route: {mode}\n")
-                fh.write("l2_enable: true\n")
-                fh.write(f"l2_upload_dir: {shared}\n")
-        procs.append(subprocess.Popen(
-            [
-                sys.executable, "-m", "flyimg_tpu.service.app", "serve",
-                "--port", str(port), "--params", params_path,
-            ],
-            stdout=subprocess.DEVNULL,
-            stderr=subprocess.DEVNULL,
-        ))
+    procs = [
+        _spawn_replica(
+            i, port, root, urls, fleet_on=fleet_on, mode=mode,
+            membership=membership and fleet_on,
+            warmstart=membership and fleet_on,
+        )
+        for i, port in enumerate(ports)
+    ]
     return procs, urls
 
 
@@ -591,6 +620,175 @@ async def _fleet_multisize_leg(client, urls: list, src: str,
     }
 
 
+async def _fleet_churn_leg(client, urls, procs, root) -> dict:
+    """Kill + rejoin mid-run (docs/fleet.md "Membership and
+    elasticity"): SIGKILL the last replica while hammering the
+    survivors, measure the error count and the re-home disruption
+    (fraction of a probe keyset whose rendezvous owner changed — the
+    minimal-disruption bar is the victim's own 1/N share), then restart
+    it twice on the same port — once warm-start-off, once on — and
+    compare first-render latency and compile misses. Requires
+    membership (the --churn spawn arms it), so re-homing is the
+    watcher's doing, not a config push."""
+    # bench_http otherwise never imports the package in-process; the
+    # probe keyset check reuses the REAL HRW implementation
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
+    from flyimg_tpu.runtime.fleet import rendezvous_owner
+
+    n = len(urls)
+    victim = n - 1
+    victim_url = urls[victim]
+    victim_port = int(victim_url.rsplit(":", 1)[1])
+    survivors = urls[:victim]
+    shared = os.path.join(root, "shared-l2")
+    # distinct PROGRAMS (blur/rotate change the device plan; pure w/h
+    # variants can share one size-bucketed program) — rendered now so
+    # the heartbeat publishes their identities before the kill
+    mix = ("w_201,h_151,o_jpg", "w_202,blr_2,o_png",
+           "w_203,h_140,r_90,o_jpg")
+    src_seed = _make_source(os.path.join(root, "churn-seed.jpg"), seed=11)
+    # same dims, different pixels: fresh cache keys over the SAME
+    # programs, so the restart probes render instead of hitting L2
+    src_cold = _make_source(os.path.join(root, "churn-cold.jpg"), seed=12)
+    src_warm = _make_source(os.path.join(root, "churn-warm.jpg"), seed=13)
+
+    async def members_of(url):
+        try:
+            resp = await client.get(f"{url}/debug/fleet")
+            return sorted(resp.json().get("members", []))
+        except (httpx.HTTPError, ValueError):
+            return None
+
+    async def wait_members(url, want, timeout_s):
+        want = sorted(want)
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if await members_of(url) == want:
+                return time.monotonic()
+            await asyncio.sleep(CHURN_BEAT_S / 2)
+        return None
+
+    async def first_render_probe(url, src):
+        """Latency + compile-miss cost of this replica's first renders
+        (the full mix, sequentially — the scale-out cold-start tax)."""
+        miss = 'flyimg_compile_events_total{result="miss"}'
+        before = await _replica_metric(client, url, miss)
+        t0 = time.monotonic()
+        ok = 0
+        for options in mix:
+            resp = await client.get(f"{url}/upload/{options}/{src}")
+            ok += 1 if resp.status_code == 200 else 0
+        latency_ms = (time.monotonic() - t0) * 1000.0
+        return {
+            "first_render_ms": round(latency_ms, 1),
+            "compile_misses": await _replica_metric(client, url, miss)
+            - before,
+            "ok": ok,
+        }
+
+    # membership must have converged before the kill means anything
+    assembled = await wait_members(urls[0], urls, CHURN_TTL_S * 6)
+    seeded_renders = 0
+    for url in urls:
+        for options in mix:
+            resp = await client.get(f"{url}/upload/{options}/{src_seed}")
+            seeded_renders += 1 if resp.status_code == 200 else 0
+    manifest = os.path.join(root, "shared-l2",
+                            "warmstart-programs.manifest")
+    deadline = time.monotonic() + 15.0
+    while time.monotonic() < deadline and not os.path.exists(manifest):
+        await asyncio.sleep(CHURN_BEAT_S)
+
+    probe_keys = [f"churn-probe-{i}" for i in range(512)]
+    owners_before = {k: rendezvous_owner(list(urls), k)
+                     for k in probe_keys}
+
+    procs[victim].kill()
+    procs[victim].wait()
+    kill_t = time.monotonic()
+    errors = 0
+    requests = 0
+    detected_s = None
+    while time.monotonic() - kill_t < CHURN_TTL_S * 3:
+        for url in survivors:
+            for options in mix:
+                requests += 1
+                try:
+                    resp = await client.get(
+                        f"{url}/upload/{options}/{src_seed}"
+                    )
+                    errors += 0 if resp.status_code == 200 else 1
+                except httpx.HTTPError:
+                    errors += 1
+        if detected_s is None:
+            if await members_of(urls[0]) == sorted(survivors):
+                detected_s = time.monotonic() - kill_t
+    owners_after = {k: rendezvous_owner(list(survivors), k)
+                    for k in probe_keys}
+    moved = [k for k in probe_keys
+             if owners_before[k] != owners_after[k]]
+    moved_from_victim = [k for k in moved
+                         if owners_before[k] == victim_url]
+
+    # rejoin A (cold control): same port, warm start off. Both rejoins
+    # run fleet_route=local — under proxy mode the probe's keys would
+    # route to the already-warm survivors and measure nothing
+    procs[victim] = _spawn_replica(
+        victim, victim_port, root, urls, fleet_on=True, mode="local",
+        membership=True, warmstart=False,
+    )
+    if not await _wait_healthy(client, [victim_url]):
+        return {"error": "cold rejoin never became healthy"}
+    cold = await first_render_probe(victim_url, src_cold)
+    procs[victim].send_signal(signal.SIGTERM)
+    procs[victim].wait()
+
+    # rejoin B (the real thing): warm start seeds the program cache
+    # from the fleet manifest before the port opens
+    procs[victim] = _spawn_replica(
+        victim, victim_port, root, urls, fleet_on=True, mode="local",
+        membership=True, warmstart=True,
+    )
+    if not await _wait_healthy(client, [victim_url]):
+        return {"error": "warm rejoin never became healthy"}
+    rejoin_t = time.monotonic()
+    converged = await wait_members(urls[0], urls, CHURN_TTL_S * 6)
+    warm = await first_render_probe(victim_url, src_warm)
+
+    return {
+        "ttl_s": CHURN_TTL_S,
+        "heartbeat_s": CHURN_BEAT_S,
+        "assembled_before_kill": assembled is not None,
+        "kill": {
+            "victim": victim_url,
+            "requests_during_outage": requests,
+            "errors_during_outage": errors,
+            "detected_after_s": (
+                round(detected_s, 2) if detected_s is not None else None
+            ),
+            "probe_keys": len(probe_keys),
+            "keys_moved": len(moved),
+            "keys_moved_from_victim": len(moved_from_victim),
+            "rehome_fraction": round(len(moved) / len(probe_keys), 3),
+            "minimal_disruption": len(moved) == len(moved_from_victim),
+        },
+        "rejoin": {
+            "cold": cold,
+            "warm": warm,
+            "warm_vs_cold_latency": (
+                round(warm["first_render_ms"] / cold["first_render_ms"], 3)
+                if cold["first_render_ms"] else None
+            ),
+            "converge_after_s": (
+                round(converged - rejoin_t, 2)
+                if converged is not None else None
+            ),
+        },
+    }
+
+
 async def _fleet_ab(args) -> int:
     """The --replicas A/B: one fleet with routing+L2+lease on, one
     control fleet of isolated replicas, same legs, one artifact
@@ -604,7 +802,8 @@ async def _fleet_ab(args) -> int:
     for name, fleet_on in configs:
         root = tempfile.mkdtemp(prefix=f"flyimg-fleet-{name}-")
         procs, urls = _spawn_fleet(
-            n, root, fleet_on=fleet_on, mode=args.fleet_route
+            n, root, fleet_on=fleet_on, mode=args.fleet_route,
+            membership=args.churn,
         )
         try:
             async with httpx.AsyncClient(
@@ -667,6 +866,25 @@ async def _fleet_ab(args) -> int:
                     "multisize": multi,
                     "per_replica": replicas,
                 }
+                if args.churn and fleet_on:
+                    churn = await _fleet_churn_leg(
+                        client, urls, procs, root
+                    )
+                    results[name]["churn"] = churn
+                    kill = churn.get("kill") or {}
+                    rejoin = churn.get("rejoin") or {}
+                    print(
+                        f"  churn: {kill.get('errors_during_outage')} "
+                        f"errors/{kill.get('requests_during_outage')} "
+                        f"requests, detected "
+                        f"{kill.get('detected_after_s')}s, re-home "
+                        f"{kill.get('rehome_fraction')} (minimal "
+                        f"{kill.get('minimal_disruption')}), first "
+                        f"render warm "
+                        f"{(rejoin.get('warm') or {}).get('first_render_ms')}ms"
+                        f" vs cold "
+                        f"{(rejoin.get('cold') or {}).get('first_render_ms')}ms"
+                    )
         finally:
             for proc in procs:
                 proc.send_signal(signal.SIGTERM)
@@ -740,6 +958,28 @@ async def _fleet_ab(args) -> int:
             ],
         },
     }
+    churn = results["fleet_on"].get("churn")
+    if churn is not None:
+        kill = churn.get("kill") or {}
+        rejoin = churn.get("rejoin") or {}
+        artifact["summary"]["churn"] = {
+            "errors_during_outage": kill.get("errors_during_outage"),
+            "rehome_fraction": kill.get("rehome_fraction"),
+            "minimal_disruption": kill.get("minimal_disruption"),
+            "detected_after_s": kill.get("detected_after_s"),
+            "first_render_cold_ms": (
+                (rejoin.get("cold") or {}).get("first_render_ms")
+            ),
+            "first_render_warm_ms": (
+                (rejoin.get("warm") or {}).get("first_render_ms")
+            ),
+            "compile_misses_cold": (
+                (rejoin.get("cold") or {}).get("compile_misses")
+            ),
+            "compile_misses_warm": (
+                (rejoin.get("warm") or {}).get("compile_misses")
+            ),
+        }
     print(json.dumps(artifact["summary"]))
     if args.fleet_out:
         with open(args.fleet_out, "w") as fh:
@@ -888,6 +1128,14 @@ async def main() -> int:
         "--fleet-out", default=None,
         help="write the fleet A/B artifact to this JSON path "
              "(e.g. benchmarks/FLEET_r01.json)")
+    ap.add_argument(
+        "--churn", action="store_true",
+        help="add a kill+rejoin leg to the fleet-on A/B run (requires "
+             "--replicas): arms fleet membership + warm start on every "
+             "replica, SIGKILLs one mid-run (error count + re-home "
+             "disruption vs the minimal 1/N bar), then restarts it "
+             "cold and warm to compare first-render latency and "
+             "compile misses")
     args = ap.parse_args()
 
     if args.replicas:

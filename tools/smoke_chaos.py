@@ -10,8 +10,11 @@ Part 2 (the campaign, docs/resilience.md "Proving it"): ONE matrix
 runner sweeping the newer fault points — ``device.backend`` (backend
 probe raises), ``fleet.proxy`` (proxied owner GET fails),
 ``l2.lease`` (lease marker IO fails), ``l2.storage`` (shared tier IO
-fails) — × {NORMAL, BROWNOUT}, asserting the standing invariants every
-time:
+fails), ``fleet.member`` (membership marker read/write/confirm/list
+fails — heartbeats count failures and retry, serving never notices),
+``warmstart.cache`` (manifest reads fail — the replica boots cold
+instead of warm) — × {NORMAL, BROWNOUT}, asserting the standing
+invariants every time:
 
 - no hang past the deadline (every request wrapped in a wait bound),
 - correct 5xx/503 mapping (the faults degrade, they never surface as
@@ -73,7 +76,10 @@ def _metric_value(text: str, name: str) -> float:
 REQUEST_TIMEOUT_S = 120.0
 
 #: the campaign's fault points × degradation levels
-CAMPAIGN_POINTS = ("device.backend", "fleet.proxy", "l2.lease", "l2.storage")
+CAMPAIGN_POINTS = (
+    "device.backend", "fleet.proxy", "l2.lease", "l2.storage",
+    "fleet.member", "warmstart.cache",
+)
 CAMPAIGN_LEVELS = ("normal", "brownout")
 
 
@@ -199,6 +205,38 @@ async def _campaign_case(point: str, level: str) -> None:
                 OSError("chaos: shared tier down")
             ),
         )
+    elif point == "fleet.member":
+        # every marker op fails: announce, heartbeats, the watch
+        # listing. Liveness is advisory — serving must never notice,
+        # the failures must be COUNTED, and no marker may exist
+        conf.update({
+            "l2_enable": True,
+            "l2_upload_dir": shared,
+            "fleet_membership_enable": True,
+            "fleet_replica_id": "http://chaos-replica",
+            "fleet_membership_ttl_s": 5.0,
+            "fleet_membership_heartbeat_s": 0.2,
+        })
+        injector.plan(
+            "fleet.member",
+            lambda **_: (_ for _ in ()).throw(
+                OSError("chaos: membership marker IO down")
+            ),
+        )
+    elif point == "warmstart.cache":
+        # manifest reads fail at boot: seeding is skipped, the replica
+        # starts cold, and later renders/publishes proceed untouched
+        conf.update({
+            "l2_enable": True,
+            "l2_upload_dir": shared,
+            "warmstart_enable": True,
+        })
+        injector.plan(
+            "warmstart.cache",
+            lambda op="read", **_: (_ for _ in ()).throw(
+                OSError("chaos: warm-start manifest unreadable")
+            ) if op == "read" else faults.PASS,
+        )
 
     rng = np.random.default_rng(7)
     src = os.path.join(tmp, "src.png")
@@ -260,6 +298,31 @@ async def _campaign_case(point: str, level: str) -> None:
                 in miss.headers.get("X-Flyimg-Degraded", "").split(","),
                 f"{label} miss tagged cpu-fallback",
             )
+        if point == "fleet.member":
+            # the beats kept failing while we served: counted, never
+            # surfaced, and nothing half-written into the shared tier
+            text = await (await client.get("/metrics")).text()
+            _require(
+                _metric_value(
+                    text, "flyimg_fleet_heartbeat_failures_total"
+                ) >= 1.0,
+                f"{label} heartbeat failures counted",
+            )
+            _require(
+                not glob.glob(os.path.join(shared, "**", "*.member"),
+                              recursive=True),
+                f"{label} no marker written through the fault",
+            )
+        if point == "warmstart.cache":
+            # unreadable manifests mean a cold boot, not a failed one
+            text = await (await client.get("/metrics")).text()
+            _require(
+                _metric_value(
+                    text,
+                    'flyimg_warmstart_programs_total{outcome="seeded"}',
+                ) == 0.0,
+                f"{label} nothing seeded through the fault",
+            )
         # standing invariants
         _require(
             not glob.glob(os.path.join(shared, "**", "*.lease"),
@@ -279,6 +342,13 @@ async def _campaign_case(point: str, level: str) -> None:
         print(f"chaos campaign OK {label}")
     finally:
         await client.close()
+    # post-close leak sweep: cleanup released every membership marker
+    # (lease markers are covered by the in-flight check above)
+    _require(
+        not glob.glob(os.path.join(shared, "**", "*.member"),
+                      recursive=True),
+        f"{label} zero leaked membership markers after close",
+    )
 
 
 async def campaign() -> None:

@@ -1,0 +1,441 @@
+"""CI elastic-membership smoke: REAL processes joining, draining, and
+crashing out of one fleet (docs/fleet.md "Membership and elasticity").
+
+Legs, in order:
+
+1. **assemble**: two subprocess replicas with membership on discover
+   each other through shared-tier markers (no fleet_replicas list
+   anywhere) and serve a small plan mix; their warm-start manifests
+   publish on the heartbeat.
+2. **cold control**: an isolated warm-start-off replica renders the
+   probe mix from a cold program cache — its compile-miss delta is the
+   baseline.
+3. **join + warm start**: a third replica boots seeded from the shared
+   manifest; every peer adds it within one TTL, HRW re-homes ONLY its
+   keys (client-side rendezvous check), and its probe-mix compile-miss
+   delta must be <= 50% of the cold control's (the scale-out
+   acceptance bar — in practice it is ~zero).
+4. **graceful drain (SIGTERM)**: the joiner exits cleanly mid-traffic:
+   zero failed requests fleet-wide, its marker is released, peers
+   converge. Drain *visibility* (/readyz walking ready -> draining ->
+   gone, marker status draining) runs in-process via app.shutdown() —
+   the same handler chain aiohttp's run_app executes on SIGTERM, whose
+   subprocess form closes the listening socket before flipping state.
+5. **crash (SIGKILL)**: a replica dies with no goodbye: cache-hit
+   requests never 5xx, its owned keys fall back to local renders (no
+   5xx), every peer drops it within one heartbeat TTL, and only ITS
+   keys re-home.
+
+Run:  JAX_PLATFORMS=cpu python tools/smoke_fleet_elastic.py
+Exit code 0 = every assertion held. Subprocesses are the point: the
+program caches are process-global, so warm-vs-cold is only observable
+across real process boundaries."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+TTL_S = 3.0
+BEAT_S = 0.5
+# distinct PROGRAMS, not just distinct outputs: the batcher buckets
+# output sizes, so pure w/h variants can share one padded program —
+# blur and rotate change the device plan itself
+MIX = ("w_101,h_76,o_jpg", "w_102,blr_2,o_png", "w_103,h_60,r_90,o_jpg")
+MISS = 'flyimg_compile_events_total{result="miss"}'
+
+
+def _require(cond: bool, what: str) -> None:
+    if not cond:
+        print(f"FAIL: {what}", file=sys.stderr)
+        raise SystemExit(1)
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn(root: str, name: str, port: int, shared: str, *,
+           membership=True, warmstart=True, l2=True, route="proxy"):
+    replica_root = os.path.join(root, name)
+    os.makedirs(replica_root, exist_ok=True)
+    params_path = os.path.join(replica_root, "params.yml")
+    with open(params_path, "w") as fh:
+        fh.write("debug: true\n")
+        fh.write(f"upload_dir: {os.path.join(replica_root, 'out')}\n")
+        fh.write(f"tmp_dir: {os.path.join(replica_root, 'tmp')}\n")
+        fh.write(f"fleet_replica_id: http://127.0.0.1:{port}\n")
+        fh.write(f"fleet_route: {route}\n")
+        if l2:
+            fh.write("l2_enable: true\n")
+            fh.write(f"l2_upload_dir: {shared}\n")
+        if membership:
+            fh.write("fleet_membership_enable: true\n")
+            fh.write(f"fleet_membership_ttl_s: {TTL_S}\n")
+            fh.write(f"fleet_membership_heartbeat_s: {BEAT_S}\n")
+        if warmstart:
+            fh.write("warmstart_enable: true\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO_ROOT)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "flyimg_tpu.service.app", "serve",
+         "--port", str(port), "--params", params_path],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env,
+    )
+    return proc, f"http://127.0.0.1:{port}"
+
+
+async def _wait_healthy(client, url: str, timeout_s: float = 120.0) -> None:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            async with client.get(f"{url}/healthz") as r:
+                if r.status == 200:
+                    return
+        except Exception:
+            pass
+        await asyncio.sleep(0.5)
+    _require(False, f"{url} never became healthy")
+
+
+async def _members(client, url: str):
+    try:
+        async with client.get(f"{url}/debug/fleet") as r:
+            return (await r.json()).get("members", [])
+    except Exception:
+        return None
+
+
+async def _wait_members(client, url: str, want, timeout_s: float) -> None:
+    want = sorted(want)
+    deadline = time.monotonic() + timeout_s
+    last = None
+    while time.monotonic() < deadline:
+        last = await _members(client, url)
+        if last == want:
+            return
+        await asyncio.sleep(BEAT_S / 2)
+    _require(False, f"{url} never converged to {want} (last saw {last})")
+
+
+async def _miss_count(client, url: str) -> float:
+    async with client.get(f"{url}/metrics") as r:
+        text = await r.text()
+    for line in text.splitlines():
+        if line.startswith(MISS + " "):
+            return float(line.rsplit(" ", 1)[1])
+    return 0.0
+
+
+async def _drive_mix(client, url: str, src: str) -> int:
+    """The canonical plan mix, sequentially. Returns the failure count."""
+    failed = 0
+    for options in MIX:
+        try:
+            async with client.get(f"{url}/upload/{options}/{src}") as r:
+                if r.status != 200:
+                    failed += 1
+        except Exception:
+            failed += 1
+    return failed
+
+
+def _assert_minimal_rehome(before_urls, after_urls, gone_or_new):
+    """HRW minimal-disruption property, client-side: every key whose
+    owner changed between the two sets moved to/from the ONE replica
+    that joined or left."""
+    from flyimg_tpu.runtime.fleet import rendezvous_owner
+
+    keys = [f"probe-{i}" for i in range(256)]
+    for key in keys:
+        owner_before = rendezvous_owner(list(before_urls), key)
+        owner_after = rendezvous_owner(list(after_urls), key)
+        if owner_before != owner_after:
+            _require(
+                gone_or_new in (owner_before, owner_after),
+                f"key {key} shuffled {owner_before} -> {owner_after} "
+                f"without touching {gone_or_new}",
+            )
+
+
+async def _inprocess_drain_leg(tmp: str) -> None:
+    """/readyz walks ready -> draining (503) and the marker flips to
+    status=draining, driven through app.shutdown() — the exact handler
+    chain run_app executes on SIGTERM."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from flyimg_tpu.appconfig import AppParameters
+    from flyimg_tpu.runtime.membership import member_slug
+    from flyimg_tpu.service.app import MEMBERSHIP_KEY, make_app
+    from flyimg_tpu.storage.tiered import member_name
+
+    shared = os.path.join(tmp, "drain-shared")
+    app = make_app(AppParameters({
+        "tmp_dir": os.path.join(tmp, "drain", "t"),
+        "upload_dir": os.path.join(tmp, "drain", "u"),
+        "debug": True,
+        "l2_enable": True,
+        "l2_upload_dir": shared,
+        "fleet_replica_id": "http://127.0.0.1:1",
+        "fleet_membership_enable": True,
+        "fleet_membership_ttl_s": TTL_S,
+        "fleet_membership_heartbeat_s": 30.0,  # no beats mid-leg
+    }))
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    ready = await client.get("/readyz")
+    _require(ready.status == 200, "drain leg: starts ready")
+    doc = json.loads(await ready.text())
+    _require(doc.get("members") == 1, f"readyz shows membership ({doc})")
+    await app.shutdown()  # what run_app does on SIGTERM
+    draining = await client.get("/readyz")
+    _require(
+        draining.status == 503
+        and json.loads(await draining.text())["status"] == "draining",
+        "readyz flips to 503 draining on shutdown",
+    )
+    marker_path = os.path.join(
+        shared, member_name(member_slug(app[MEMBERSHIP_KEY].replica_id))
+    )
+    with open(marker_path) as fh:
+        _require(
+            json.load(fh)["status"] == "draining",
+            "marker re-written as draining",
+        )
+    await client.close()
+    _require(
+        not os.path.exists(marker_path),
+        "marker released after cleanup (gone)",
+    )
+
+
+async def main() -> int:
+    import aiohttp
+    import numpy as np
+
+    from flyimg_tpu.codecs import encode
+
+    tmp = tempfile.mkdtemp(prefix="flyimg-elastic-smoke-")
+    shared = os.path.join(tmp, "shared-l2")
+    yy, xx = np.mgrid[0:150, 0:200].astype(np.float32)
+    base = np.stack(
+        [xx * (255.0 / 199.0), yy * (255.0 / 149.0),
+         (xx + yy) * (255.0 / 348.0)],
+        axis=-1,
+    ).astype(np.uint8)
+    # src2: SAME dimensions, different pixels — same programs, distinct
+    # cache keys, so the probe mix actually renders instead of serving
+    # the assemble leg's artifacts from the shared tier
+    src1 = os.path.join(tmp, "src1.png")
+    src2 = os.path.join(tmp, "src2.png")
+    with open(src1, "wb") as fh:
+        fh.write(encode(base, "png"))
+    with open(src2, "wb") as fh:
+        fh.write(encode(base[::-1, ::-1].copy(), "png"))
+
+    print("== leg 0: in-process drain visibility (readyz walk)")
+    await _inprocess_drain_leg(tmp)
+    print("   ok: ready -> draining(503) -> marker released")
+
+    procs = {}
+    timeout = aiohttp.ClientTimeout(total=120)
+    async with aiohttp.ClientSession(timeout=timeout) as client:
+        try:
+            print("== leg 1: two replicas assemble with no static list")
+            pa, pb = _free_port(), _free_port()
+            procs["a"], url_a = _spawn(tmp, "a", pa, shared)
+            procs["b"], url_b = _spawn(tmp, "b", pb, shared)
+            await _wait_healthy(client, url_a)
+            await _wait_healthy(client, url_b)
+            both = [url_a, url_b]
+            for url in both:
+                await _wait_members(client, url, both, TTL_S * 4)
+            print(f"   ok: both replicas see {both}")
+            failed = 0
+            for url in both:
+                failed += await _drive_mix(client, url, src1)
+            _require(failed == 0, "assemble-leg mix all 200s")
+            manifest_path = os.path.join(
+                shared, "warmstart-programs.manifest"
+            )
+            deadline = time.monotonic() + 15.0
+            entries = 0
+            while time.monotonic() < deadline:
+                if os.path.exists(manifest_path):
+                    with open(manifest_path) as fh:
+                        entries = len(json.load(fh).get("entries", []))
+                    if entries >= len(MIX):
+                        break
+                await asyncio.sleep(BEAT_S)
+            _require(
+                entries >= len(MIX),
+                f"warm-start manifest published >= {len(MIX)} program "
+                f"identities on the heartbeat (saw {entries})",
+            )
+            print(f"   ok: mix served, manifest holds {entries} programs")
+
+            print("== leg 2: cold control (isolated, warm start off)")
+            px = _free_port()
+            procs["x"], url_x = _spawn(
+                tmp, "cold-x", px, shared,
+                membership=False, warmstart=False, l2=False,
+            )
+            await _wait_healthy(client, url_x)
+            cold_before = await _miss_count(client, url_x)
+            _require(
+                await _drive_mix(client, url_x, src2) == 0,
+                "cold-control mix all 200s",
+            )
+            cold_delta = await _miss_count(client, url_x) - cold_before
+            _require(
+                cold_delta >= len(MIX),
+                f"cold control compiles the mix ({cold_delta} misses)",
+            )
+            procs["x"].terminate()
+            procs["x"].wait(timeout=30)
+            del procs["x"]
+            print(f"   ok: cold boot pays {cold_delta:.0f} compile misses")
+
+            print("== leg 3: third replica joins warm")
+            pc = _free_port()
+            procs["c"], url_c = _spawn(
+                tmp, "c", pc, shared, route="local",
+            )
+            await _wait_healthy(client, url_c)
+            fleet3 = sorted(both + [url_c])
+            for url in (url_a, url_b):
+                await _wait_members(client, url, fleet3, TTL_S * 4)
+            _assert_minimal_rehome(both, fleet3, url_c)
+            print("   ok: peers added the joiner; only its keys re-homed")
+            async with client.get(f"{url_c}/debug/fleet") as r:
+                seeded = (await r.json())["warmstart"]["stats"]["seeded"]
+            _require(
+                seeded >= len(MIX),
+                f"joiner seeded >= {len(MIX)} programs at boot ({seeded})",
+            )
+            warm_before = await _miss_count(client, url_c)
+            _require(
+                await _drive_mix(client, url_c, src2) == 0,
+                "warm-joiner probe mix all 200s",
+            )
+            warm_delta = await _miss_count(client, url_c) - warm_before
+            _require(
+                warm_delta <= 0.5 * cold_delta,
+                f"warm start halves compile misses (warm {warm_delta:.0f}"
+                f" vs cold {cold_delta:.0f})",
+            )
+            print(
+                f"   ok: seeded {seeded} programs; probe mix cost "
+                f"{warm_delta:.0f} misses vs {cold_delta:.0f} cold"
+            )
+
+            print("== leg 4: graceful SIGTERM under traffic")
+            hammer_failed = {"n": 0}
+            stop_hammer = asyncio.Event()
+
+            async def hammer():
+                while not stop_hammer.is_set():
+                    hammer_failed["n"] += await _drive_mix(
+                        client, url_a, src1
+                    )
+                    await asyncio.sleep(0.05)
+
+            task = asyncio.create_task(hammer())
+            procs["c"].send_signal(signal.SIGTERM)
+            # off-thread wait: a blocking wait() would park the event
+            # loop and silently pause the hammer for the whole drain
+            rc = await asyncio.to_thread(procs["c"].wait, 60)
+            await _wait_members(client, url_a, both, TTL_S * 4)
+            stop_hammer.set()
+            await task
+            _require(rc == 0, f"SIGTERM exit is clean (rc {rc})")
+            _require(
+                hammer_failed["n"] == 0,
+                f"zero failed requests during the drain "
+                f"({hammer_failed['n']} failed)",
+            )
+            slug_c = url_c.replace("http://", "").replace(":", "-")
+            leftover = [n for n in os.listdir(shared)
+                        if n.endswith(".member") and slug_c in n]
+            _require(
+                not leftover, f"drained replica released its marker "
+                f"({leftover})",
+            )
+            del procs["c"]
+            print("   ok: clean exit, marker released, zero failures")
+
+            print("== leg 5: SIGKILL crash detection")
+            # a key B already rendered in leg 1 — now a shared-tier hit
+            hit_url = f"{url_a}/upload/{MIX[0]}/{src1}"
+            procs["b"].kill()
+            procs["b"].wait(timeout=30)
+            del procs["b"]
+            failures = 0
+            for _ in range(3):
+                try:
+                    async with client.get(hit_url) as r:
+                        failures += 0 if r.status == 200 else 1
+                except Exception:
+                    failures += 1
+                if await _drive_mix(client, url_a, src1):
+                    failures += 1
+                await asyncio.sleep(BEAT_S)
+            _require(
+                failures == 0,
+                f"no request fails while the crash ages out ({failures})",
+            )
+            await _wait_members(client, url_a, [url_a], TTL_S * 4)
+            _assert_minimal_rehome(both, [url_a], url_b)
+            async with client.get(f"{url_a}/debug/fleet") as r:
+                markers = (await r.json())["markers"]
+            dead = [m for m in markers if m.get("replica") == url_b]
+            _require(
+                dead and dead[0]["expired"] is True,
+                f"the corpse's marker is visibly expired ({dead})",
+            )
+            print("   ok: crash aged out within one TTL, zero 5xx")
+
+            print("== leg 6: last replica exits clean")
+            procs["a"].terminate()
+            rc = procs["a"].wait(timeout=60)
+            _require(rc == 0, f"final SIGTERM exit is clean (rc {rc})")
+            del procs["a"]
+            leases = [n for n in os.listdir(shared)
+                      if n.endswith(".lease")]
+            _require(not leases, f"zero leaked lease markers ({leases})")
+            members = [n for n in os.listdir(shared)
+                       if n.endswith(".member")]
+            # the ONLY marker left is the SIGKILLed corpse's — expired,
+            # TTL-reclaimed by any future watcher; graceful exits
+            # released theirs
+            _require(
+                len(members) <= 1,
+                f"only the corpse's marker may remain ({members})",
+            )
+            print("   ok: markers accounted for")
+        finally:
+            for proc in procs.values():
+                proc.kill()
+
+    print(
+        "elastic fleet smoke OK: assemble/join/drain/crash all held; "
+        f"warm start cut compile misses to {warm_delta:.0f} from "
+        f"{cold_delta:.0f}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(asyncio.run(main()))
