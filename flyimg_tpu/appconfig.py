@@ -367,6 +367,40 @@ SERVER_DEFAULTS: Dict[str, Any] = {
     # heartbeat/watch cadence: each beat renews this replica's marker,
     # re-lists the live set, and piggybacks warm-start publication
     "fleet_membership_heartbeat_s": 5.0,
+    # --- fleet observatory + autoscale recommendation
+    # (runtime/observatory.py; docs/fleet.md "Fleet observatory &
+    # autoscaling signal"). Default OFF: no digest markers, no
+    # flyimg_fleet_* rollup metrics, no recommendation — byte-identical
+    # serving (pinned by tests/test_fleet_observatory.py) ---
+    # publish a TTL'd signal digest (SLO burn, brownout level, batch
+    # occupancy, shed/deadline rates, backend health, queue depth) on
+    # each membership beat, assemble every peer's digest into the
+    # fleet rollup, and run the scale-out/in recommender over it.
+    # Requires fleet_membership_enable (the digest rides its beat and
+    # expires on its TTL)
+    "fleet_observatory_enable": False,
+    # recommender bounds: never recommend below/above this many
+    # routable replicas
+    "fleet_autoscale_min_replicas": 1,
+    "fleet_autoscale_max_replicas": 8,
+    # scale-out triggers (any one): worst normalized burn across the
+    # fleet (1.0 = a replica's own brownout threshold), fleet batch
+    # occupancy, or any replica's brownout level reaching this rung
+    "fleet_autoscale_burn_out": 1.0,
+    "fleet_autoscale_occupancy_out": 0.85,
+    "fleet_autoscale_brownout_out": 2,
+    # scale-in requires ALL quiet below these lower bars (hysteresis:
+    # the hold band between the in/out bars absorbs signal wobble)
+    "fleet_autoscale_burn_in": 0.5,
+    "fleet_autoscale_occupancy_in": 0.5,
+    # dwell after any adopted scale_out/scale_in flip before the NEXT
+    # non-hold flip may be adopted (dropping to hold is immediate)
+    "fleet_autoscale_cooldown_s": 60.0,
+    # honor a scale_in recommendation INWARD: the deterministic drain
+    # candidate (last sorted ready member — every replica computes the
+    # same one) walks itself through the graceful-drain path. Off =
+    # recommend-only; an external scaler owns capacity
+    "fleet_autoscale_drain": False,
     # --- fleet-wide warm start (runtime/warmstart.py; docs/fleet.md).
     # Default OFF: no recorder installed, no manifests read/written,
     # byte-identical serving ---
@@ -439,6 +473,11 @@ SERVER_DEFAULTS: Dict[str, Any] = {
     # (runtime/membership.py from_params) so TTL/skew tests never sleep
     # — wall, not monotonic: marker ages are compared across processes
     "fleet_membership_clock": None,
+    # injectable WALL clock for signal-digest timestamps and the
+    # autoscale cooldown (runtime/observatory.py from_params) — same
+    # hook style as fleet_membership_clock, and wall for the same
+    # reason: digest ages are compared across processes
+    "fleet_observatory_clock": None,
 }
 
 

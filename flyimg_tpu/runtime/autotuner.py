@@ -433,16 +433,14 @@ class PolicyAutotuner:
         self._last_signals: Dict = {}
         self._history: deque = deque(maxlen=max(8, int(history)))
         self._adjustments_total = 0
-        # per-controller recorded_total at the previous evaluation (the
-        # launches_delta recency signal)
-        self._prev_recorded: Dict[str, float] = {}
-        # signal sources (attach_signals)
-        self._slo = None
-        self._brownout = None
-        self._host_pipeline = None
-        self._flight_recorder = None
-        self._batch_stats_fn: Optional[Callable[[str], Dict]] = None
-        self._reuse_fn: Optional[Callable[[], Dict]] = None
+        # the signal-assembly machinery now lives in
+        # runtime/observatory.py (SignalWindow) so the fleet
+        # observatory reads the same vocabulary; this tuner owns its
+        # own instance — assemble() diffs recorded_total per call, so
+        # sharing one window would halve every launches_delta
+        from flyimg_tpu.runtime.observatory import SignalWindow
+
+        self._window = SignalWindow()
 
     @classmethod
     def from_params(cls, params, *, metrics=None) -> "PolicyAutotuner":
@@ -540,15 +538,11 @@ class PolicyAutotuner:
         """Wire the observatory's read surfaces. All optional — a
         missing source contributes neutral signals (and therefore no
         adjustments that depend on it)."""
-        if metrics is not None:
-            self._batch_stats_fn = (
-                lambda name: metrics.batch_efficiency(name).stats()
-            )
-        self._slo = slo
-        self._brownout = brownout
-        self._host_pipeline = host_pipeline
-        self._flight_recorder = flight_recorder
-        self._reuse_fn = reuse_fn
+        self._window.attach(
+            metrics=metrics, slo=slo, brownout=brownout,
+            host_pipeline=host_pipeline, flight_recorder=flight_recorder,
+            reuse_fn=reuse_fn,
+        )
 
     def known_good(self) -> Dict[str, float]:
         """The last-known-good policy table (what a freeze reverts to;
@@ -608,71 +602,7 @@ class PolicyAutotuner:
     # -- signal assembly ---------------------------------------------------
 
     def _signals(self) -> Dict:
-        from flyimg_tpu.ops.resample import kernel_mode
-
-        out: Dict = {"controllers": {}, "host": {}}
-        if self._batch_stats_fn is not None:
-            for name in ("device", "codec"):
-                try:
-                    stats = dict(self._batch_stats_fn(name))
-                except Exception:
-                    continue
-                # recency: launches since the PREVIOUS evaluation. The
-                # efficiency window is count-based and never expires, so
-                # without this a single historical burst would read as
-                # "live traffic" forever (the cold-pool shed gate)
-                total = float(stats.get("recorded_total", 0.0))
-                prev = self._prev_recorded.get(name)
-                stats["launches_delta"] = (
-                    total - prev if prev is not None else 0.0
-                )
-                self._prev_recorded[name] = total
-                out["controllers"][name] = stats
-        slo = self._slo
-        if slo is not None and getattr(slo, "enabled", False):
-            try:
-                out["burn_fast_norm"] = slo.burn_rate("fast") / max(
-                    slo.burn_threshold_fast, 1e-9
-                )
-                out["burn_slow_norm"] = slo.burn_rate("slow") / max(
-                    slo.burn_threshold_slow, 1e-9
-                )
-            except Exception:
-                pass
-        if self._brownout is not None:
-            try:
-                out["brownout_level"] = int(self._brownout.level())
-            except Exception:
-                pass
-        pipeline = self._host_pipeline
-        if pipeline is not None and getattr(pipeline, "enabled", False):
-            try:
-                for stage, stats in pipeline.snapshot().items():
-                    bound = max(stats.get("bound", 0.0), 1.0)
-                    workers = max(stats.get("workers", 1.0), 1.0)
-                    out["host"][stage] = {
-                        "saturation": stats.get("pending", 0.0) / bound,
-                        "busy_frac": stats.get("busy", 0.0) / workers,
-                        "workers": workers,
-                    }
-            except Exception:
-                pass
-        if self._reuse_fn is not None:
-            try:
-                out["reuse"] = self._reuse_fn()
-            except Exception:
-                pass
-        if self._flight_recorder is not None:
-            try:
-                # audit context (also surfaced via /debug/autotune): the
-                # most recent launches behind the efficiency windows
-                out["flightrecorder"] = (
-                    self._flight_recorder.recent_summary()
-                )
-            except Exception:
-                pass
-        out["kernel_mode"] = kernel_mode()
-        return out
+        return self._window.assemble()
 
     # -- evaluation --------------------------------------------------------
 

@@ -261,6 +261,26 @@ class FleetRouter:
         if isinstance(doc, dict) and doc.get("device") == "down":
             self.mark_device_down(owner)
 
+    def peer_health(self) -> Dict[str, object]:
+        """Routing-health snapshot for ``/debug/fleet/status``
+        (runtime/observatory.py): per-peer remaining device-down TTL,
+        joined there with membership and the digest rollup so one
+        document answers "who is alive, who is limping, and who are we
+        routing around"."""
+        now = time.monotonic()
+        down = {
+            replica: round(expires - now, 3)
+            for replica, expires in dict(self._peer_down).items()
+            if expires > now
+        }
+        return {
+            "replicas": list(self.replicas),
+            "replica_id": self.self_id,
+            "mode": self.mode,
+            "enabled": self.enabled,
+            "device_down": down,
+        }
+
     def owner(self, key: str) -> str:
         # ONE reference read: a concurrent update_replicas (POST
         # endpoint, SIGHUP) swaps the list between this replica's

@@ -111,6 +111,11 @@ class FleetMembership:
         self.heartbeat_s = max(float(heartbeat_s), 0.05)
         self.supervisor = supervisor
         self.warmstart = warmstart
+        # fleet observatory (runtime/observatory.py), wired by the app
+        # after construction (it needs this membership as its digest
+        # status source): its digest publish + rollup + recommender
+        # beat piggybacks on step() like the warm-start publish
+        self.observatory = None
         self.metrics = metrics
         # wall clock, not monotonic: marker timestamps are compared
         # ACROSS replicas (each reader against its own clock — the
@@ -155,7 +160,10 @@ class FleetMembership:
     def _marker_name(self) -> str:
         return member_name(member_slug(self.replica_id))
 
-    def _marker_doc(self) -> dict:
+    def current_status(self) -> str:
+        """The status the next heartbeat will publish — also the
+        status the observatory stamps on this replica's signal digest,
+        so the two markers never disagree about one replica."""
         status = self._status
         if status == "ready" and self.supervisor is not None:
             try:
@@ -167,9 +175,12 @@ class FleetMembership:
                     status = "degraded"
             except Exception:
                 pass
+        return status
+
+    def _marker_doc(self) -> dict:
         return {
             "replica": self.replica_id,
-            "status": status,
+            "status": self.current_status(),
             "token": self._token,
             "started_at": self._started_at,
             "renewed_at": self._clock(),
@@ -351,6 +362,17 @@ class FleetMembership:
                     "warm-start publish failed (next beat retries): "
                     "%s", exc,
                 )
+        if self.observatory is not None:
+            # same piggyback: the signal digest publishes (and the
+            # fleet rollup + autoscale recommendation re-assemble) on
+            # the heartbeat cadence, the fleet's one shared-tier beat
+            try:
+                self.observatory.on_beat()
+            except Exception as exc:
+                logging.getLogger(LOGGER).warning(
+                    "observatory beat failed (next beat retries): %s",
+                    exc,
+                )
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -370,6 +392,17 @@ class FleetMembership:
             return
         self.announce()
         self.watch()
+        if self.observatory is not None:
+            # first digest publishes WITH the announce, not one
+            # heartbeat later: a joining replica is observable the
+            # moment it is routable
+            try:
+                self.observatory.on_beat()
+            except Exception as exc:
+                logging.getLogger(LOGGER).warning(
+                    "observatory boot beat failed (next beat "
+                    "retries): %s", exc,
+                )
 
         def run() -> None:
             while not self._stop.wait(self.heartbeat_s):

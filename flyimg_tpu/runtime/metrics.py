@@ -431,6 +431,25 @@ class MetricsRegistry:
         rates/budget alongside the batch-efficiency fields."""
         self._slo = engine
 
+    def family_total(self, family: str) -> float:
+        """Sum of every sample in one counter/gauge family across all
+        label sets — the fleet observatory's digest fields (shed and
+        deadline totals, queue depths; runtime/observatory.py) without
+        each caller re-parsing exposition names. Dead gauge callbacks
+        (NaN) are skipped, like the renderer tolerates them."""
+        with self._lock:
+            samples = list(self._counters.values()) + list(
+                self._gauges.values()
+            )
+        total = 0.0
+        for metric in samples:
+            if _bare(metric.name) != family:
+                continue
+            value = metric.value
+            if value == value:  # skip NaN
+                total += float(value)
+        return total
+
     # -- recording helpers used by the serving path ------------------------
 
     def record_request(self, route: str, status: int) -> None:

@@ -437,6 +437,36 @@ class SloEngine:
             "breached": 1.0 if self.breached else 0.0,
         }
 
+    def digest_fields(self) -> Dict[str, float]:
+        """The compact burn fields the fleet observatory publishes in
+        this replica's signal digest (runtime/observatory.py): raw and
+        threshold-normalized burn per window (1.0 = this replica's own
+        brownout threshold — normalization makes burns comparable
+        across replicas with different objectives), plus the fast
+        window's request count so the fleet rollup can request-weight
+        the fleet-wide burn."""
+        if not self.enabled:
+            return {}
+        fast = self.burn_rate("fast")
+        slow = self.burn_rate("slow")
+        with self._lock:
+            requests = sum(
+                sl.total for sl in self._window_slices_locked(
+                    self._clock(), self.window_fast_s
+                )
+            )
+        return {
+            "burn_fast": round(fast, 4),
+            "burn_slow": round(slow, 4),
+            "burn_fast_norm": round(
+                fast / max(self.burn_threshold_fast, 1e-9), 4
+            ),
+            "burn_slow_norm": round(
+                slow / max(self.burn_threshold_slow, 1e-9), 4
+            ),
+            "window_requests": float(requests),
+        }
+
     # -- metrics wiring ----------------------------------------------------
 
     def register_metrics(self, registry) -> None:

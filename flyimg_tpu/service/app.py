@@ -86,6 +86,9 @@ SUPERVISOR_KEY: web.AppKey = web.AppKey("device_supervisor", object)
 # elastic fleet membership (runtime/membership.py): the SIGHUP handler
 # and the split-brain guard on /debug/fleet/replicas reach it here
 MEMBERSHIP_KEY: web.AppKey = web.AppKey("membership", object)
+# fleet observatory (runtime/observatory.py): tests and the observatory
+# smoke reach the digest/rollup/recommender agent through this key
+OBSERVATORY_KEY: web.AppKey = web.AppKey("observatory", object)
 
 # routes that run the image pipeline get a trace; infrastructure routes
 # (/metrics scrapes, health probes) would only fill the ring with noise
@@ -529,6 +532,41 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
         warmstart=warmstart if warmstart.enabled else None,
         metrics=metrics,
     )
+    # fleet observatory + autoscale recommender (runtime/observatory.py;
+    # docs/fleet.md "Fleet observatory & autoscaling signal"): publish
+    # this replica's signal digest on the membership beat, assemble
+    # every peer's into the fleet rollup (flyimg_fleet_* gauges,
+    # /debug/fleet/status), and run the deterministic scale-out/in
+    # recommender over it — scale-in honored inward through the
+    # graceful-drain path when fleet_autoscale_drain is on. Inert (no
+    # markers, no metrics, no digest IO) with fleet_observatory_enable
+    # off or membership off.
+    from flyimg_tpu.runtime.observatory import FleetObservatory
+
+    observatory = FleetObservatory.from_params(
+        params,
+        storage=storage.shared,
+        membership=membership,
+        slo=slo,
+        brownout=brownout,
+        supervisor=supervisor if supervisor.enabled else None,
+        metrics=metrics,
+    )
+    if observatory.enabled:
+        observatory.window.attach(
+            metrics=metrics,
+            slo=slo,
+            brownout=brownout,
+            host_pipeline=host_pipeline,
+            flight_recorder=flight_recorder,
+            reuse_fn=(
+                reuse_signal_fn(metrics)
+                if handler.reuse_enable else None
+            ),
+        )
+        # the digest/rollup/recommendation beat rides the membership
+        # heartbeat, the same piggyback slot as the warm-start publish
+        membership.observatory = observatory
 
     @web.middleware
     async def observability(request: web.Request, handler):
@@ -661,6 +699,7 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
     app[AUTOTUNER_KEY] = autotuner
     app[SUPERVISOR_KEY] = supervisor
     app[MEMBERSHIP_KEY] = membership
+    app[OBSERVATORY_KEY] = observatory
 
     # readiness vs liveness: /healthz answers "is the process + device
     # runtime up", /readyz answers "should a load balancer route here".
@@ -697,6 +736,7 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
         if warmstart.enabled:
             warmstart.maybe_publish()
             warmstart_mod.uninstall()
+        observatory.close()  # digest released before the member marker
         membership.close()
         if injector is not None:
             from flyimg_tpu.testing import faults
@@ -939,7 +979,14 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
         of rotation before the batcher drain runs."""
         import json as _json
 
-        if draining["flag"]:
+        # two drain initiators share this answer: process shutdown
+        # (on_shutdown flips the flag) and an autoscale scale-in
+        # nomination (the observatory calls membership.begin_drain()
+        # directly — the marker flips for peers, and readiness must
+        # agree so the external scaler pulls the nominated replica)
+        if draining["flag"] or (
+            membership.enabled and membership.current_status() == "draining"
+        ):
             return web.Response(
                 text=_json.dumps({"status": "draining"}), status=503,
                 content_type="application/json",
@@ -1264,6 +1311,26 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
             text=_json.dumps(doc), content_type="application/json"
         )
 
+    async def debug_fleet_status(_request: web.Request) -> web.Response:
+        """One JSON snapshot of the whole fleet (docs/fleet.md "Fleet
+        observatory & autoscaling signal"): every live signal digest,
+        the assembled rollup, the current autoscale recommendation,
+        joined with membership (markers + live set) and routing health
+        (device-down peers) — the document an external scaler polls."""
+        import json as _json
+
+        denied = _debug_gate_404()
+        if denied is not None:
+            return denied
+        doc = {
+            "observatory": observatory.snapshot(),
+            "membership": membership.snapshot(),
+            "routing": fleet.peer_health(),
+        }
+        return web.Response(
+            text=_json.dumps(doc), content_type="application/json"
+        )
+
     async def debug_fleet_replicas(request: web.Request) -> web.Response:
         """Dynamic replica-set reload (docs/fleet.md "Dynamic replica
         sets"): swap the rendezvous routing set online. Body:
@@ -1362,6 +1429,7 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
     app.router.add_get("/debug/device", debug_device)
     app.router.add_get("/debug/autotune", debug_autotune)
     app.router.add_get("/debug/fleet", debug_fleet)
+    app.router.add_get("/debug/fleet/status", debug_fleet_status)
     app.router.add_post("/debug/fleet/replicas", debug_fleet_replicas)
     # Route table is config-overridable like the reference's
     # config/routes.yml (RoutesResolver.php); imageSrc uses a catch-all

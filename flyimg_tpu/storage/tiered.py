@@ -56,6 +56,12 @@ LEASE_SUFFIX = ".lease"
 MEMBER_PREFIX = "fleet-member--"
 MEMBER_SUFFIX = ".member"
 
+#: fleet-observatory signal digests (runtime/observatory.py) ride the
+#: same shared tier and the same flat-name discipline, published on the
+#: membership heartbeat beat next to the member marker
+DIGEST_PREFIX = "fleet-digest--"
+DIGEST_SUFFIX = ".digest"
+
 
 def lease_name(name: str) -> str:
     """Storage object name of the lease marker guarding ``name``."""
@@ -65,6 +71,11 @@ def lease_name(name: str) -> str:
 def member_name(slug: str) -> str:
     """Storage object name of the membership marker for a replica slug."""
     return f"{MEMBER_PREFIX}{slug}{MEMBER_SUFFIX}"
+
+
+def digest_name(slug: str) -> str:
+    """Storage object name of the signal digest for a replica slug."""
+    return f"{DIGEST_PREFIX}{slug}{DIGEST_SUFFIX}"
 
 
 class TieredStorage(Storage):

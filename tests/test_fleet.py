@@ -746,6 +746,62 @@ def test_fleet_route_span_lands_on_proxying_trace(tmp_path):
     _run(go())
 
 
+def test_proxy_hop_joins_callers_trace_under_fleet_route_span(tmp_path):
+    """The proxy hop forwards a traceparent minted under the caller's
+    ``fleet.route`` span (runtime/fleet.py proxy(), overriding any
+    inbound header), so the owner's spans land in the SAME trace as
+    CHILDREN of fleet.route — one distributed tree, not two sibling
+    traces that only share timestamps."""
+
+    async def go():
+        clients, urls, replicas, src = await _two_replica_fleet(tmp_path)
+        try:
+            path, _ = _owned_request(replicas, urls[1], src)
+            resp = await clients[0].get(path)
+            assert resp.status == 200
+            trace_id = resp.headers.get("traceparent", "").split("-")[1]
+            assert trace_id
+
+            def walk(node, out):
+                out.append(node)
+                for child in node.get("children", ()):
+                    walk(child, out)
+                return out
+
+            async def spans_of(client):
+                tree = json.loads(await (
+                    await client.get(f"/debug/traces/{trace_id}")
+                ).text())
+                spans = []
+                for root in tree["spans"]:
+                    walk(root, spans)
+                return spans
+
+            # the caller's side of the hop
+            caller = await spans_of(clients[0])
+            route = next(s for s in caller if s["name"] == "fleet.route")
+            assert route["attributes"]["fleet.outcome"] == "proxied"
+            # the owner kept a trace under the CALLER's id — adopted
+            # from the forwarded traceparent, not minted fresh
+            owner = await spans_of(clients[1])
+            owner_root = owner[0]
+            assert owner_root["name"] == "request"
+            # ...and its root is parented under the caller's
+            # fleet.route span: the cross-replica tree joins on span
+            # ids, so a trace viewer nests the owner's whole pipeline
+            # (fetch/decode/device/encode) inside the proxy hop
+            assert owner_root["parent_id"] == route["span_id"]
+            owner_names = [s["name"] for s in owner]
+            assert "device_execute" in owner_names
+            # both replicas tagged their spans with their own identity
+            assert owner_root["attributes"]["fleet.replica_id"] == urls[1]
+        finally:
+            for client in clients:
+                await client.close()
+
+    _run(go())
+
+
 def test_debug_off_hides_replica_header(tmp_path):
     async def go():
         from flyimg_tpu.service.app import make_app

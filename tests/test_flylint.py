@@ -280,6 +280,7 @@ def _registry_fixture(root):
             metrics.counter("flyimg_rogue_total", "h")
             metrics.counter('flyimg_shape_total{a="x"}', "h")
             metrics.counter(f'flyimg_shape_total{{b="{op}"}}', "h")
+            metrics.counter('flyimg_labeled_total{reason="x"}', "h")
         """)
     _write(root, "docs/application-options.md", """\
         | Key | Default | Used by |
@@ -290,6 +291,9 @@ def _registry_fixture(root):
         """)
     _write(root, "docs/observability.md", """\
         | `flyimg_documented_total` | – | documented |
+        | `flyimg_labeled_total` | – | emitted with a label this row omits |
+        | `flyimg_ghost_total` | – | no flyimg_tpu/ emission site |
+        | `flyimg_wild_*` | – | wildcard reference, never flagged |
         """)
 
 
@@ -303,8 +307,8 @@ def test_registry_rules_trip_together(tmp_path):
         "knob-undeclared", "knob-unread", "knob-undocumented",
         "knob-doc-unknown", "fault-point-undeclared",
         "fault-point-unused", "metric-undocumented",
-        "metric-inconsistent", "exception-unmapped",
-        "exception-map-unknown",
+        "metric-inconsistent", "metrics-doc-parity",
+        "exception-unmapped", "exception-map-unknown",
     }
     assert "mystery_knob" in by_rule["knob-undeclared"][0].message
     assert "unread_knob" in by_rule["knob-unread"][0].message
@@ -314,6 +318,15 @@ def test_registry_rules_trip_together(tmp_path):
     assert "unused.point" in by_rule["fault-point-unused"][0].message
     assert "flyimg_rogue_total" in by_rule["metric-undocumented"][0].message
     assert "flyimg_shape_total" in by_rule["metric-inconsistent"][0].message
+    parity = {f.message for f in by_rule["metrics-doc-parity"]}
+    # doc -> code: a documented family with no emission site
+    assert any("flyimg_ghost_total" in m for m in parity)
+    # code -> doc: an emitted label key the family's doc row omits
+    assert any(
+        "flyimg_labeled_total" in m and "`reason`" in m for m in parity
+    )
+    # the wildcard reference is a family-set pointer, not a family
+    assert not any("flyimg_wild_" in m for m in parity)
     assert "UnmappedException" in by_rule["exception-unmapped"][0].message
     assert "GhostException" in by_rule["exception-map-unknown"][0].message
     # the dynamic f-string fault point resolved against declared prefixes:

@@ -1041,6 +1041,57 @@ async def _scrape_observability(client: httpx.AsyncClient, base: str):
     }
 
 
+async def _sample_signals(client: httpx.AsyncClient, base: str,
+                          interval_s: float, stop: asyncio.Event):
+    """Background sampler behind the report's ``signal_timeline``: one
+    joined reading of /debug/slo (burn rates) and /debug/fleet/status
+    (the standing autoscale recommendation + fleet rollup) every
+    ``interval_s``, timestamped from the run start — so a bench
+    artifact shows not just the latency the load produced but the
+    control-plane signals it drove (when did burn cross the threshold,
+    when did the recommendation flip). Endpoints serving 404 (debug or
+    observatory off) contribute nothing; an all-404 run yields an
+    empty timeline, not an error."""
+    samples = []
+    t0 = time.monotonic()
+    while True:
+        sample: dict = {"t": round(time.monotonic() - t0, 2)}
+        try:
+            resp = await client.get(f"{base}/debug/slo")
+            if resp.status_code == 200:
+                windows = resp.json().get("windows") or {}
+                sample["burn_fast"] = (
+                    (windows.get("fast") or {}).get("burn_rate")
+                )
+                sample["burn_slow"] = (
+                    (windows.get("slow") or {}).get("burn_rate")
+                )
+        except (httpx.HTTPError, ValueError):
+            pass
+        try:
+            resp = await client.get(f"{base}/debug/fleet/status")
+            if resp.status_code == 200:
+                observatory = resp.json().get("observatory") or {}
+                rec = observatory.get("recommendation") or {}
+                if rec:
+                    sample["recommendation"] = rec.get("action")
+                    sample["delta"] = rec.get("delta")
+                rollup = observatory.get("rollup") or {}
+                if rollup:
+                    sample["fleet_burn_worst"] = rollup.get("burn_worst")
+                    sample["fleet_routable"] = rollup.get("routable")
+        except (httpx.HTTPError, ValueError):
+            pass
+        if len(sample) > 1:
+            samples.append(sample)
+        if stop.is_set():
+            return samples
+        try:
+            await asyncio.wait_for(stop.wait(), timeout=interval_s)
+        except asyncio.TimeoutError:
+            pass
+
+
 async def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--base", default=None, help="base URL of a running service")
@@ -1048,6 +1099,12 @@ async def main() -> int:
     ap.add_argument("--duration", type=float, default=10.0)
     ap.add_argument("--burst", type=int, default=2000, help="burst request count (0=skip)")
     ap.add_argument("--conc", type=int, default=32, help="burst concurrency")
+    ap.add_argument(
+        "--signal-sample-s", type=float, default=1.0,
+        help="sampling period for the SLO-burn / autoscale-recommendation "
+             "timeline embedded in report rows (reads /debug/slo and "
+             "/debug/fleet/status; 0 = off)",
+    )
     ap.add_argument(
         "--miss", type=int, default=0,
         help="cache-miss scenario: N distinct sources, each a fresh "
@@ -1224,6 +1281,13 @@ async def main() -> int:
 
             print(f"target {base}  rate {args.rate} req/s x {args.duration}s "
                   f"+ burst {args.burst} @ conc {args.conc}")
+            stop_signals = asyncio.Event()
+            signal_task = (
+                asyncio.create_task(_sample_signals(
+                    client, base, args.signal_sample_s, stop_signals,
+                ))
+                if args.signal_sample_s > 0 else None
+            )
             all_rows = []
             for name, options in SCENARIOS:
                 url = f"{base}/upload/{options}/{src}"
@@ -1504,6 +1568,20 @@ async def main() -> int:
             # sweep artifact), so BENCH_r06+ carries attribution, not
             # just throughput. None sections = target served 404
             # (debug off).
+            # the control-plane timeline rides every row next to the
+            # latency it explains (empty when the target's debug
+            # endpoints answered 404 throughout)
+            if signal_task is not None:
+                stop_signals.set()
+                timeline = await signal_task
+                if timeline:
+                    for row in all_rows:
+                        row["signal_timeline"] = timeline
+                    print(json.dumps({"signal_timeline": {
+                        "samples": len(timeline),
+                        "last": timeline[-1],
+                    }}))
+
             obs = await _scrape_observability(client, base)
             if obs is not None and any(v is not None for v in obs.values()):
                 for row in all_rows:

@@ -51,6 +51,7 @@ RULE_FAULT_UNDECLARED = "fault-point-undeclared"
 RULE_FAULT_UNUSED = "fault-point-unused"
 RULE_METRIC_UNDOCUMENTED = "metric-undocumented"
 RULE_METRIC_INCONSISTENT = "metric-inconsistent"
+RULE_METRIC_DOC_PARITY = "metrics-doc-parity"
 RULE_EXC_UNMAPPED = "exception-unmapped"
 RULE_EXC_UNKNOWN = "exception-map-unknown"
 
@@ -108,6 +109,11 @@ class RegistryChecker:
         RULE_METRIC_INCONSISTENT: (
             "one metric family is registered with conflicting label sets "
             "or types"
+        ),
+        RULE_METRIC_DOC_PARITY: (
+            "docs/observability.md and the emitted flyimg_* families "
+            "disagree: a documented family no flyimg_tpu/ source emits, "
+            "or an emitted label key the family's doc text never names"
         ),
         RULE_EXC_UNMAPPED: (
             "an exceptions.py class has no _ERROR_STATUS mapping in "
@@ -390,6 +396,78 @@ class RegistryChecker:
                     message=(
                         f"metric `{bare}` is registered here but not "
                         f"listed in {OBSERVABILITY_DOC}"
+                    ),
+                )
+        yield from self._check_metric_doc_parity(project, doc, families)
+
+    def _check_metric_doc_parity(
+        self, project: Project, doc: Optional[str], families: Dict[str, Dict]
+    ) -> Iterable[Finding]:
+        """Both directions of the metrics-doc contract beyond presence.
+
+        doc -> code runs on RAW SOURCE TEXT, not the AST collection:
+        some families are emitted as literal exposition lines (e.g.
+        ``flyimg_uptime_seconds`` appended inside ``render_prometheus``)
+        that no counter()/gauge()/histogram() call ever names. Wildcard
+        references (``flyimg_slo_*``) and exposition suffixes
+        (``_bucket``/``_sum``/``_count`` in scrape examples) are
+        normalized, not flagged.
+        """
+        if doc is None:
+            return
+        code_text = "\n".join(
+            src.text for src in project.files
+            if src.relpath.startswith("flyimg_tpu/")
+        )
+        doc_lines = doc.splitlines()
+        seen: Set[str] = set()
+        for m in re.finditer(r"flyimg_[a-z0-9_]+", doc):
+            token = m.group(0)
+            if m.end() < len(doc) and doc[m.end()] == "*":
+                continue  # wildcard family reference, not one family
+            if token in seen:
+                continue
+            seen.add(token)
+            base = re.sub(r"_(?:bucket|sum|count)$", "", token)
+            if token in code_text or base in code_text:
+                continue
+            yield Finding(
+                rule=RULE_METRIC_DOC_PARITY,
+                path=OBSERVABILITY_DOC,
+                line=doc.count("\n", 0, m.start()) + 1,
+                symbol="",
+                message=(
+                    f"documented metric `{token}` is not emitted by any "
+                    "flyimg_tpu/ source (stale doc, or the family lost "
+                    "its emission site)"
+                ),
+            )
+        # code -> doc: every label key a documented family is emitted
+        # with must appear somewhere on a doc line naming that family
+        # (an undocumented label is a scrape dimension operators cannot
+        # know to query). Families absent from the doc already fired
+        # metric-undocumented; re-flagging their labels would be noise.
+        for bare, fam in sorted(families.items()):
+            if bare not in doc or not fam["labels"]:
+                continue
+            keys: Set[str] = set()
+            for label_set in fam["labels"]:
+                keys |= set(label_set)
+            fam_lines = [ln for ln in doc_lines if bare in ln]
+            for key in sorted(keys):
+                if any(
+                    re.search(rf"\b{re.escape(key)}\b", ln)
+                    for ln in fam_lines
+                ):
+                    continue
+                path, line, symbol = fam["site"]
+                yield Finding(
+                    rule=RULE_METRIC_DOC_PARITY,
+                    path=path, line=line, symbol=symbol,
+                    message=(
+                        f"metric `{bare}` is emitted with label `{key}` "
+                        f"but no {OBSERVABILITY_DOC} line naming the "
+                        "family mentions that label key"
                     ),
                 )
 

@@ -1,0 +1,430 @@
+"""CI fleet-observatory smoke: REAL subprocess replicas publishing
+signal digests on the heartbeat, rolling them up fleet-wide, and
+walking the full autoscale recommendation cycle (docs/fleet.md "Fleet
+observatory & autoscaling signal").
+
+The burn signal is scripted, not simulated: one replica ("hot") runs
+with a microscopic ``slo_latency_p99_ms`` so every image request it
+serves counts against its SLO, while its peers run with a huge one and
+never burn. Short SLO windows make the burn decay observable within
+the smoke's budget. Occupancy thresholds are parked out of reach so
+burn is the ONE deciding signal and the decision sequence is exact.
+
+Legs, in order:
+
+1. **assemble at the floor**: two replicas (hot + mid,
+   ``fleet_autoscale_min_replicas: 2``) discover each other, both
+   digests land in every ``/debug/fleet/status`` within one TTL, and
+   the quiet fleet recommends ``hold`` ("already at min_replicas") —
+   NOT scale_in, and nobody drains.
+2. **burn -> scale_out**: sustained load on the hot replica pushes its
+   normalized burn past ``fleet_autoscale_burn_out``; the PEER's
+   rollup reflects it (cross-replica signal propagation, the point of
+   the digests) and both replicas flip to ``scale_out`` delta +1 with
+   the burn evidence in the reason; the ``flyimg_fleet_*`` gauges
+   agree with the JSON.
+3. **the scaler obeys outward**: a third replica (sorted LAST, the
+   future drain candidate) joins mid-burn; every rollup reaches
+   replicas=3 within one TTL and the joiner itself recommends
+   scale_out off its first rollups.
+4. **load drop -> cooldown -> scale_in -> drain**: the hammer stops
+   with zero failed requests; burn drains out of the short SLO
+   windows; after the cooldown the fleet flips to ``scale_in`` and the
+   last-sorted ready member — the joiner, and ONLY the joiner —
+   self-nominates through the PR 16 graceful-drain path (/readyz 503
+   draining, edge-triggered scale_in transition counter moved).
+   Peers drop it from the live set, the rollup shows one draining
+   replica, and the recommendation falls back to hold at the
+   min_replicas floor (no drain cascade).
+5. **drained exit**: the joiner SIGTERMs cleanly and releases BOTH its
+   markers (member + digest); the survivors still serve.
+
+Run:  JAX_PLATFORMS=cpu python tools/smoke_fleet_observatory.py
+Exit code 0 = every assertion held. Subprocesses are the point: the
+digests cross real process boundaries through the shared tier, which
+is the only channel the rollup has."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+TTL_S = 3.0
+BEAT_S = 0.5
+COOLDOWN_S = 2.0
+SLO_WINDOW_S = 6.0
+OPTIONS = "w_101,h_76,o_jpg"
+
+
+def _require(cond: bool, what: str) -> None:
+    if not cond:
+        print(f"FAIL: {what}", file=sys.stderr)
+        raise SystemExit(1)
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn(root: str, name: str, port: int, shared: str, *, hot: bool):
+    replica_root = os.path.join(root, name)
+    os.makedirs(replica_root, exist_ok=True)
+    params_path = os.path.join(replica_root, "params.yml")
+    # the hot replica's p99 objective is microscopic (every request is
+    # an SLO miss), its peers' is enormous (none ever is): burn is a
+    # scripted per-replica signal, not a timing accident
+    p99 = 0.0001 if hot else 600000.0
+    with open(params_path, "w") as fh:
+        fh.write("debug: true\n")
+        fh.write(f"upload_dir: {os.path.join(replica_root, 'out')}\n")
+        fh.write(f"tmp_dir: {os.path.join(replica_root, 'tmp')}\n")
+        fh.write(f"fleet_replica_id: http://127.0.0.1:{port}\n")
+        fh.write("fleet_route: local\n")
+        fh.write("l2_enable: true\n")
+        fh.write(f"l2_upload_dir: {shared}\n")
+        fh.write("fleet_membership_enable: true\n")
+        fh.write(f"fleet_membership_ttl_s: {TTL_S}\n")
+        fh.write(f"fleet_membership_heartbeat_s: {BEAT_S}\n")
+        fh.write("fleet_observatory_enable: true\n")
+        fh.write("fleet_autoscale_min_replicas: 2\n")
+        fh.write(f"fleet_autoscale_cooldown_s: {COOLDOWN_S}\n")
+        # park occupancy out of reach: burn is the one deciding signal,
+        # so the scale_out/scale_in sequence below is exact
+        fh.write("fleet_autoscale_occupancy_out: 2.0\n")
+        fh.write("fleet_autoscale_occupancy_in: 1.5\n")
+        fh.write("fleet_autoscale_drain: true\n")
+        fh.write(f"slo_latency_p99_ms: {p99}\n")
+        fh.write(f"slo_window_fast_s: {SLO_WINDOW_S}\n")
+        fh.write(f"slo_window_slow_s: {SLO_WINDOW_S}\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO_ROOT)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "flyimg_tpu.service.app", "serve",
+         "--port", str(port), "--params", params_path],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env,
+    )
+    return proc
+
+
+async def _wait_healthy(client, url: str, timeout_s: float = 120.0) -> None:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            async with client.get(f"{url}/healthz") as r:
+                if r.status == 200:
+                    return
+        except Exception:
+            pass
+        await asyncio.sleep(0.5)
+    _require(False, f"{url} never became healthy")
+
+
+async def _status(client, url: str):
+    try:
+        async with client.get(f"{url}/debug/fleet/status") as r:
+            if r.status != 200:
+                return None
+            return await r.json(content_type=None)
+    except Exception:
+        return None
+
+
+async def _wait_status(client, url: str, pred, what: str,
+                       timeout_s: float) -> dict:
+    """Poll /debug/fleet/status until pred(observatory_slice) holds."""
+    deadline = time.monotonic() + timeout_s
+    last = None
+    while time.monotonic() < deadline:
+        doc = await _status(client, url)
+        if doc is not None:
+            last = doc.get("observatory") or {}
+            try:
+                if pred(last):
+                    return last
+            except Exception:
+                pass
+        await asyncio.sleep(BEAT_S / 2)
+    _require(False, f"{url}: {what} (last observatory slice: {last})")
+    raise AssertionError  # unreachable
+
+
+async def _metric(client, url: str, line_prefix: str) -> float:
+    async with client.get(f"{url}/metrics") as r:
+        text = await r.text()
+    for line in text.splitlines():
+        if line.startswith(line_prefix + " "):
+            return float(line.rsplit(" ", 1)[1])
+    return 0.0
+
+
+async def _readyz(client, url: str) -> int:
+    async with client.get(f"{url}/readyz") as r:
+        return r.status
+
+
+async def _render(client, url: str, src: str) -> bool:
+    try:
+        async with client.get(f"{url}/upload/{OPTIONS}/{src}") as r:
+            return r.status == 200
+    except Exception:
+        return False
+
+
+def _recommend(obs: dict) -> dict:
+    return obs.get("recommendation") or {}
+
+
+async def main() -> int:
+    import aiohttp
+    import numpy as np
+
+    from flyimg_tpu.codecs import encode
+
+    tmp = tempfile.mkdtemp(prefix="flyimg-observatory-smoke-")
+    shared = os.path.join(tmp, "shared-l2")
+    yy, xx = np.mgrid[0:120, 0:160].astype(np.float32)
+    base = np.stack(
+        [xx * (255.0 / 159.0), yy * (255.0 / 119.0),
+         (xx + yy) * (255.0 / 278.0)],
+        axis=-1,
+    ).astype(np.uint8)
+    src = os.path.join(tmp, "src.png")
+    with open(src, "wb") as fh:
+        fh.write(encode(base, "png"))
+
+    # the drain candidate self-selects as the LAST sorted ready member
+    # (runtime/observatory.py _maybe_drain), so pick the roles off the
+    # sorted URL order up front: hot = first (burns, never drains),
+    # joiner = last (joins in leg 3, drains in leg 4)
+    ports = [_free_port(), _free_port(), _free_port()]
+    urls = sorted(f"http://127.0.0.1:{p}" for p in ports)
+    hot_url, mid_url, join_url = urls[0], urls[1], urls[2]
+    by_url = {u: int(u.rsplit(":", 1)[1]) for u in urls}
+
+    procs = {}
+    timeout = aiohttp.ClientTimeout(total=120)
+    async with aiohttp.ClientSession(timeout=timeout) as client:
+        try:
+            print("== leg 1: two replicas assemble at the min floor")
+            procs[hot_url] = _spawn(
+                tmp, "hot", by_url[hot_url], shared, hot=True
+            )
+            procs[mid_url] = _spawn(
+                tmp, "mid", by_url[mid_url], shared, hot=False
+            )
+            await _wait_healthy(client, hot_url)
+            await _wait_healthy(client, mid_url)
+            pair = [hot_url, mid_url]
+            for url in pair:
+                obs = await _wait_status(
+                    client, url,
+                    lambda o: sorted((o.get("digests") or {})) == pair
+                    and (o.get("rollup") or {}).get("replicas") == 2,
+                    "both digests in the rollup", TTL_S * 4,
+                )
+            # quiet fleet AT the floor: hold, not scale_in, nobody drains
+            for url in pair:
+                obs = await _wait_status(
+                    client, url,
+                    lambda o: _recommend(o).get("action") == "hold"
+                    and "min_replicas" in str(_recommend(o).get("reason")),
+                    "quiet floor holds (not scale_in)", TTL_S * 4,
+                )
+                _require(
+                    _recommend(obs).get("delta") == 0,
+                    f"hold carries delta 0 ({_recommend(obs)})",
+                )
+                _require(
+                    await _readyz(client, url) == 200,
+                    f"{url} stays ready at the floor",
+                )
+            ready_gauge = await _metric(
+                client, hot_url, 'flyimg_fleet_replicas{status="ready"}'
+            )
+            _require(
+                ready_gauge == 2.0,
+                f"fleet_replicas ready gauge == 2 ({ready_gauge})",
+            )
+            # render only on MID here: a single render on the hot
+            # replica would already start the burn leg
+            _require(
+                await _render(client, mid_url, src),
+                "pre-burn render on the cool replica is a 200",
+            )
+            digests_on_disk = [
+                n for n in os.listdir(shared) if n.endswith(".digest")
+            ]
+            _require(
+                len(digests_on_disk) == 2,
+                f"two digest markers on the shared tier ({digests_on_disk})",
+            )
+            print(f"   ok: digests {pair} rolled up, hold at min_replicas")
+
+            print("== leg 2: burn on the hot replica flips scale_out")
+            failed = {"n": 0}
+            stop_hammer = asyncio.Event()
+
+            async def hammer():
+                while not stop_hammer.is_set():
+                    if not await _render(client, hot_url, src):
+                        failed["n"] += 1
+                    await asyncio.sleep(0.05)
+
+            task = asyncio.create_task(hammer())
+            for url in pair:
+                obs = await _wait_status(
+                    client, url,
+                    lambda o: _recommend(o).get("action") == "scale_out",
+                    "burn flips the recommendation to scale_out", 90.0,
+                )
+                rec = _recommend(obs)
+                _require(
+                    rec.get("delta") == 1 and "burn" in str(rec.get("reason")),
+                    f"scale_out carries delta +1 and burn evidence ({rec})",
+                )
+            # the PEER's rollup carries the hot replica's burn — the
+            # digest channel, not local observation
+            mid_obs = await _status(client, mid_url)
+            rollup = (mid_obs or {}).get("observatory", {}).get("rollup", {})
+            _require(
+                float(rollup.get("burn_worst", 0.0)) >= 1.0,
+                f"peer rollup reflects the hot burn ({rollup})",
+            )
+            _require(
+                await _metric(
+                    client, mid_url, "flyimg_fleet_autoscale_recommendation"
+                ) == 1.0,
+                "autoscale gauge agrees with the JSON (+1)",
+            )
+            _require(
+                await _metric(client, mid_url, "flyimg_fleet_burn_worst")
+                >= 1.0,
+                "fleet burn_worst gauge over the scale-out bar",
+            )
+            print(f"   ok: scale_out on both, reason: {rec.get('reason')}")
+
+            print("== leg 3: the scaler obeys — a third replica joins")
+            procs[join_url] = _spawn(
+                tmp, "joiner", by_url[join_url], shared, hot=False
+            )
+            await _wait_healthy(client, join_url)
+            for url in urls:
+                await _wait_status(
+                    client, url,
+                    lambda o: (o.get("rollup") or {}).get("replicas") == 3,
+                    "rollup reaches replicas=3", TTL_S * 4,
+                )
+            # the joiner reads the same rollup and reaches the same
+            # verdict off its first beats (still burning)
+            await _wait_status(
+                client, join_url,
+                lambda o: _recommend(o).get("action") == "scale_out",
+                "the joiner recommends scale_out too", TTL_S * 4,
+            )
+            print("   ok: fleet of 3, joiner sees the burn")
+
+            print("== leg 4: load drop -> cooldown -> scale_in -> drain")
+            stop_hammer.set()
+            await task
+            _require(
+                failed["n"] == 0,
+                f"zero failed requests under the burn ({failed['n']})",
+            )
+            # burn drains out of the short SLO windows; after the
+            # cooldown the fleet flips scale_in and the LAST sorted
+            # ready member (the joiner) self-nominates a drain
+            deadline = time.monotonic() + SLO_WINDOW_S * 4 + 60.0
+            while time.monotonic() < deadline:
+                if await _readyz(client, join_url) == 503:
+                    break
+                await asyncio.sleep(BEAT_S / 2)
+            _require(
+                await _readyz(client, join_url) == 503,
+                "the joiner drained on the scale_in nomination",
+            )
+            _require(
+                await _readyz(client, hot_url) == 200
+                and await _readyz(client, mid_url) == 200,
+                "ONLY the last-sorted ready member drained",
+            )
+            _require(
+                await _metric(
+                    client, join_url,
+                    'flyimg_fleet_autoscale_transitions_total{to="scale_in"}',
+                ) >= 1.0,
+                "edge-triggered scale_in transition counted on the joiner",
+            )
+            # the rollup absorbs the drain and falls back to the floor:
+            # one draining replica, two ready, hold at min_replicas —
+            # no drain cascade
+            for url in pair:
+                obs = await _wait_status(
+                    client, url,
+                    lambda o: ((o.get("rollup") or {}).get("by_status") or {})
+                    .get("draining") == 1
+                    and _recommend(o).get("action") == "hold"
+                    and "min_replicas" in str(_recommend(o).get("reason")),
+                    "post-drain rollup holds at the floor", 60.0,
+                )
+            draining_gauge = await _metric(
+                client, hot_url, 'flyimg_fleet_replicas{status="draining"}'
+            )
+            _require(
+                draining_gauge == 1.0,
+                f"fleet_replicas draining gauge == 1 ({draining_gauge})",
+            )
+            _require(
+                await _render(client, hot_url, src)
+                and await _render(client, mid_url, src),
+                "survivors still serve after the drain",
+            )
+            print("   ok: scale_in drained the joiner, floor holds")
+
+            print("== leg 5: the drained replica exits clean")
+            procs[join_url].send_signal(signal.SIGTERM)
+            rc = await asyncio.to_thread(procs[join_url].wait, 60)
+            _require(rc == 0, f"SIGTERM exit is clean (rc {rc})")
+            del procs[join_url]
+            slug = join_url.replace("http://", "").replace(":", "-")
+            leftover = [
+                n for n in os.listdir(shared)
+                if slug in n and (
+                    n.endswith(".member") or n.endswith(".digest")
+                )
+            ]
+            _require(
+                not leftover,
+                f"drained replica released member AND digest ({leftover})",
+            )
+            await _wait_status(
+                client, hot_url,
+                lambda o: (o.get("rollup") or {}).get("replicas") == 2,
+                "rollup back to the surviving pair", TTL_S * 4,
+            )
+            print("   ok: markers released, rollup back to 2")
+        finally:
+            for proc in procs.values():
+                proc.kill()
+
+    print(
+        "fleet observatory smoke OK: digests propagated, "
+        "scale_out on burn, scale_in drained exactly one replica, "
+        "zero failed requests"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(asyncio.run(main()))
