@@ -98,6 +98,10 @@ SERVER_DEFAULTS: Dict[str, Any] = {
     # (0 disables the budget; non-positive interval disables the loop)
     "cache_max_bytes": 0,
     "cache_prune_interval_s": 300.0,
+    # orphaned atomic-write temp files (`.part`, left by a crash between
+    # the temp write and its rename) older than this are reclaimed by
+    # the same prune pass; 0 disables the sweep
+    "cache_part_ttl_s": 3600.0,
     # --- resilience knobs (runtime/resilience.py; docs/architecture.md
     # "Resilience") ---
     # per-request latency budget, minted at HTTP ingress and consumed by
@@ -108,6 +112,13 @@ SERVER_DEFAULTS: Dict[str, Any] = {
     "fetch_connect_timeout_s": 3.0,
     "fetch_read_timeout_s": 10.0,
     "fetch_write_timeout_s": 10.0,
+    # object-store client component timeouts (storage/s3.py botocore
+    # Config connect/read; storage/gcs.py per-call deadlines): the same
+    # split-timeout contract the source fetch honors, so a blackholed
+    # bucket endpoint fails at the connect cap instead of the client
+    # library's default (often 60s+). 0 keeps the library default.
+    "storage_connect_timeout_s": 0.0,
+    "storage_read_timeout_s": 0.0,
     # transient-failure retry: capped exponential backoff, FULL jitter
     "retry_max_attempts": 3,
     "retry_base_backoff_s": 0.05,
@@ -348,6 +359,42 @@ SERVER_DEFAULTS: Dict[str, Any] = {
     # `l2_lease` brownout component reads 1.0 — a fleet-wide hot-key
     # stampede registers as load instead of looking idle
     "brownout_lease_ref": 8.0,
+    # write a blake2b checksum sidecar ("<name>.b2") next to every
+    # artifact written through to the shared tier — the anti-entropy
+    # scrubber's torn-write detector (runtime/tiersupervisor.py). Off =
+    # no sidecars, magic-sniff only
+    "l2_checksum_enable": False,
+    # --- shared-tier (L2) outage supervisor (runtime/tiersupervisor.py;
+    # docs/resilience.md "Island mode"). Default OFF: no storm counting,
+    # no prober/scrubber threads, no flyimg_tier_* metrics, serving is
+    # byte-identical (pinned by tests/test_tier_supervisor.py) ---
+    # consecutive L2 failures within the storm window trip the tier into
+    # ISLAND mode: every L2 op short-circuits locally (no per-op
+    # timeouts), writes/manifest merges queue in a bounded write-behind
+    # journal, and a background prober re-promotes + replays the journal
+    # once the tier answers again
+    "tier_supervisor_enable": False,
+    # storm gate: this many CONSECUTIVE L2 failures, all inside the
+    # window, trip island mode (any success resets the count)
+    "tier_storm_threshold": 5,
+    "tier_storm_window_s": 30.0,
+    # re-promotion prober: probe cadence while islanded, and how many
+    # consecutive clean probes re-attach (flap damping doubles the
+    # requirement after each rapid re-trip, capped at 8x)
+    "tier_probe_interval_s": 5.0,
+    "tier_probe_hysteresis": 2,
+    # write-behind journal bounds: at most this many distinct intents
+    # (dedup by key — hot keys cost one entry; overflow drops oldest,
+    # counted) and drop entries older than the TTL at replay time
+    "tier_journal_max_entries": 512,
+    "tier_journal_ttl_s": 900.0,
+    # anti-entropy scrubber: walk a bounded random sample of L2
+    # artifacts per period, verify magic-sniff + checksum sidecar, and
+    # delete-and-count corrupt/torn entries from BOTH tiers. Requires
+    # tier_supervisor_enable
+    "tier_scrub_enable": False,
+    "tier_scrub_interval_s": 60.0,
+    "tier_scrub_sample": 8,
     # --- elastic fleet membership (runtime/membership.py;
     # docs/fleet.md "Membership and elasticity"). Default OFF: serving
     # is byte-identical — no markers, no heartbeat thread, no metrics,
@@ -478,6 +525,10 @@ SERVER_DEFAULTS: Dict[str, Any] = {
     # hook style as fleet_membership_clock, and wall for the same
     # reason: digest ages are compared across processes
     "fleet_observatory_clock": None,
+    # injectable monotonic clock for the tier supervisor's storm window
+    # / probe / journal-TTL bookkeeping (runtime/tiersupervisor.py
+    # from_params) — same hook style as device_supervisor_clock
+    "tier_supervisor_clock": None,
 }
 
 

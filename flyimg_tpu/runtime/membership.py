@@ -121,6 +121,19 @@ class FleetMembership:
         # ACROSS replicas (each reader against its own clock — the
         # skew cases are pinned in tests/test_fleet_membership.py)
         self._clock = clock
+        # optional runtime.tiersupervisor.TierSupervisor wired by the
+        # app: while islanded, heartbeat/watch marker IO short-circuits
+        # and routing continues against the last live view (whose
+        # staleness the gauge below surfaces)
+        self.tier_supervisor = None
+        # view staleness (satellite of docs/resilience.md "Shared-tier
+        # outage survival"): age of the last successful marker listing.
+        # A watcher silently frozen on its previous live set — outage,
+        # islanding, or a misbehaving backend — is observable through
+        # ``flyimg_fleet_view_stale_seconds`` / ``expired_view`` even
+        # with the tier supervisor off.
+        self._created_at = clock()
+        self._last_list_ok_at: Optional[float] = None
         # one token per agent lifetime: close() must never delete a
         # marker another process (same replica id, config error)
         # overwrote — the L2Lease.release discipline
@@ -153,6 +166,13 @@ class FleetMembership:
                 "flyimg_fleet_members",
                 "Live fleet members in this replica's rendezvous set",
                 fn=self.member_count,
+            )
+            self.metrics.gauge(
+                "flyimg_fleet_view_stale_seconds",
+                "Age of the last successful membership marker listing "
+                "— a frozen live view (outage, island mode) grows this "
+                "past the membership TTL",
+                fn=self.view_stale_seconds,
             )
 
     # -- marker IO ---------------------------------------------------------
@@ -189,7 +209,15 @@ class FleetMembership:
 
     def _write_marker(self, purpose: str = "write") -> bool:
         """One heartbeat write. Failure is counted and absorbed — the
-        next beat retries; peers age us out only after the TTL."""
+        next beat retries; peers age us out only after the TTL.
+        Islanded, the write is skipped outright (not a failure — the
+        tier supervisor already knows; peers age us out after the TTL
+        exactly as if the write had failed, and re-promotion's next
+        beat re-announces us)."""
+        tier = self.tier_supervisor
+        if tier is not None and tier.islanded():
+            tier.count_skip("heartbeat")
+            return False
         try:
             # fault hook (flyimg_tpu/testing/faults.py fleet.member)
             faults.fire(
@@ -202,9 +230,13 @@ class FleetMembership:
                     "utf-8"
                 ),
             )
+            if tier is not None:
+                tier.record_success("member")
             return True
         except Exception as exc:
             self._heartbeat_failures += 1
+            if tier is not None:
+                tier.record_failure("member")
             if self.metrics is not None:
                 self.metrics.counter(
                     "flyimg_fleet_heartbeat_failures_total",
@@ -283,6 +315,13 @@ class FleetMembership:
         known world, never to an empty one)."""
         if not self.enabled:
             return None
+        tier = self.tier_supervisor
+        if tier is not None and tier.islanded():
+            # island mode: keep routing against the last live view
+            # without paying the dead tier's listing timeout; the view
+            # staleness gauge keeps growing, so the freeze is labeled
+            tier.count_skip("watch")
+            return None
         try:
             faults.fire(
                 "fleet.member", op="list", name=MEMBER_PREFIX,
@@ -290,11 +329,16 @@ class FleetMembership:
             )
             names = self.storage.list_names(MEMBER_PREFIX)
         except Exception as exc:
+            if tier is not None:
+                tier.record_failure("member")
             logging.getLogger(LOGGER).warning(
                 "membership marker listing failed (keeping the "
                 "previous live set): %s", exc,
             )
             return None
+        self._last_list_ok_at = self._clock()
+        if tier is not None:
+            tier.record_success("member")
         live = set()
         for name in names or ():
             if not str(name).endswith(MEMBER_SUFFIX):
@@ -451,6 +495,12 @@ class FleetMembership:
         if thread is not None:
             thread.join(timeout=max(self.heartbeat_s * 2, 1.0))
             self._thread = None
+        tier = self.tier_supervisor
+        if tier is not None and tier.islanded():
+            # shutdown during an outage: skip the marker release rather
+            # than paying its timeouts; the TTL reclaims it
+            tier.count_skip("heartbeat")
+            return
         try:
             doc = self._read_marker(self._marker_name())
             if doc is None or doc.get("token") == self._token:
@@ -473,6 +523,23 @@ class FleetMembership:
             live = self._live
         return float(len(live)) if live is not None else 0.0
 
+    def view_stale_seconds(self) -> float:
+        """Age of the last successful marker listing (agent age when
+        none ever succeeded) — the ``flyimg_fleet_view_stale_seconds``
+        gauge. 0.0 while disabled."""
+        if not self.enabled:
+            return 0.0
+        base = self._last_list_ok_at
+        if base is None:
+            base = self._created_at
+        return max(self._clock() - base, 0.0)
+
+    def expired_view(self) -> bool:
+        """True when the live view is older than the membership TTL —
+        every marker in it may have expired unseen, so routing runs on
+        a world that can no longer be confirmed."""
+        return self.enabled and self.view_stale_seconds() > self.ttl_s
+
     def members(self) -> List[str]:
         with self._lock:
             return list(self._live or [])
@@ -483,8 +550,12 @@ class FleetMembership:
         wedged replica's stale marker is visible before it ages
         out)."""
         markers = []
+        tier = self.tier_supervisor
+        islanded = tier is not None and tier.islanded()
         try:
-            names = self.storage.list_names(MEMBER_PREFIX) or []
+            names = [] if islanded else (
+                self.storage.list_names(MEMBER_PREFIX) or []
+            )
         except Exception:
             names = []
         for name in sorted(str(n) for n in names):
@@ -510,6 +581,8 @@ class FleetMembership:
             "heartbeat_s": self.heartbeat_s,
             "members": self.members(),
             "heartbeat_failures": self._heartbeat_failures,
+            "view_stale_seconds": round(self.view_stale_seconds(), 3),
+            "expired_view": self.expired_view(),
             "markers": markers,
         }
 

@@ -358,6 +358,11 @@ class FleetObservatory:
         # ACROSS replicas (each reader against its own clock — the
         # skew cases are pinned in tests/test_fleet_observatory.py)
         self._clock = clock
+        # optional runtime.tiersupervisor.TierSupervisor wired by the
+        # app: while islanded the whole digest beat short-circuits and
+        # the previous rollup keeps feeding the gauges, loudly labeled
+        # stale (docs/resilience.md "Shared-tier outage survival")
+        self.tier_supervisor = None
         # one token per agent lifetime: close() must never delete a
         # digest another process (same replica id, config error)
         # overwrote — the membership/L2Lease release discipline
@@ -462,7 +467,9 @@ class FleetObservatory:
                 f'flyimg_fleet_digest_skipped_total{{reason="{reason}"}}',
                 "Signal digests excluded from the fleet rollup "
                 "(stale = older than its TTL, corrupt = unreadable or "
-                "not JSON, alien = wrong schema version or no replica)",
+                "not JSON, alien = wrong schema version or no replica, "
+                "island = whole beat short-circuited by tier island "
+                "mode)",
             ).inc()
 
     # -- digest marker IO --------------------------------------------------
@@ -567,9 +574,13 @@ class FleetObservatory:
                 self._digest_name(),
                 json.dumps(doc, sort_keys=True).encode("utf-8"),
             )
+            if self.tier_supervisor is not None:
+                self.tier_supervisor.record_success("member")
             return True
         except Exception as exc:
             self._publish_failures += 1
+            if self.tier_supervisor is not None:
+                self.tier_supervisor.record_failure("member")
             if self.metrics is not None:
                 self.metrics.counter(
                     "flyimg_fleet_digest_failures_total",
@@ -725,6 +736,19 @@ class FleetObservatory:
         request."""
         if not self.enabled:
             return
+        tier = self.tier_supervisor
+        if tier is not None and tier.islanded():
+            # island mode: publish + collect would each pay the dead
+            # tier's timeouts for nothing. Keep the previous rollup
+            # feeding the gauges, but degrade LOUDLY: skip counted,
+            # rollup stale-labeled in /debug/fleet/status until the
+            # first post-re-promotion beat reassembles it fresh.
+            tier.count_skip("digest")
+            self._count_skip("island")
+            with self._lock:
+                if self._rollup:
+                    self._rollup = dict(self._rollup, stale=True)
+            return
         self.publish()
         collected = self.collect()
         with self._lock:
@@ -799,6 +823,10 @@ class FleetObservatory:
         for ITS owner; the TTL reclaims anything undeletable)."""
         if not self.enabled:
             return
+        tier = self.tier_supervisor
+        if tier is not None and tier.islanded():
+            tier.count_skip("digest")
+            return  # the TTL reclaims the marker
         try:
             raw = self.storage.read(self._digest_name())
             doc = json.loads(raw.decode("utf-8"))

@@ -150,6 +150,14 @@ class VariantIndex:
         self._lock = threading.Lock()
         self._io_lock = threading.Lock()  # serializes manifest writes
         self._sources: "OrderedDict[str, _SourceState]" = OrderedDict()
+        # optional runtime.tiersupervisor.TierSupervisor wired by the
+        # app: manifest write-throughs feed its storm detector, and
+        # while islanded they journal a merge intent instead of paying
+        # the dead shared tier's per-write timeout
+        self._supervisor = None
+
+    def attach_supervisor(self, supervisor) -> None:
+        self._supervisor = supervisor
 
     @classmethod
     def from_params(cls, params, *, storage=None):
@@ -264,6 +272,21 @@ class VariantIndex:
             del state.variants[name]
         self._persist(source_key)
 
+    def discard_name(self, name: str) -> None:
+        """Drop one rendition by output name alone — the anti-entropy
+        scrubber's entry point (runtime/tiersupervisor.py): it knows
+        which artifact it purged but not which source indexed it. The
+        table is bounded (``max_sources`` × ``max_variants``), so the
+        scan is cheap at the scrubber's duty cycle."""
+        with self._lock:
+            owners = [
+                source_key
+                for source_key, state in self._sources.items()
+                if name in state.variants
+            ]
+        for source_key in owners:
+            self.discard(source_key, name)
+
     def _bound_sources_locked(self) -> None:
         while len(self._sources) > self.max_sources:
             self._sources.popitem(last=False)
@@ -318,6 +341,14 @@ class VariantIndex:
     def _store_manifest(self, source_key: str, doc: Optional[dict]) -> None:
         if doc is None or self._storage is None:
             return
+        sup = self._supervisor
+        if sup is not None and sup.islanded():
+            # island mode (runtime/tiersupervisor.py): journal the merge
+            # intent instead of paying the dead tier's write timeout —
+            # replay merges it into the live manifest at re-promotion
+            sup.count_skip("manifest")
+            sup.journal_manifest(source_key, doc)
+            return
         try:
             self._storage.write(
                 manifest_name(source_key),
@@ -326,12 +357,24 @@ class VariantIndex:
         except Exception as exc:
             # persistence is an optimization for cold processes; a failed
             # write must never fail the render that triggered it
+            if sup is not None:
+                sup.record_failure("manifest")
+                sup.journal_manifest(source_key, doc)
             logging.getLogger(LOGGER).warning(
                 "variant manifest write for %s failed: %s", source_key, exc
             )
+            return
+        if sup is not None:
+            sup.record_success("manifest")
 
     def _load_manifest(self, source_key: str) -> Optional[dict]:
         if self._storage is None:
+            return None
+        sup = self._supervisor
+        if sup is not None and sup.islanded():
+            # a cold-seed read against a dead tier would pay the per-op
+            # timeout on the render path; absent is the honest answer
+            sup.count_skip("manifest")
             return None
         try:
             raw = self._storage.read(manifest_name(source_key))
@@ -378,3 +421,41 @@ class VariantIndex:
             loaded_at=now,
             negative=False,
         )
+
+
+def replay_manifest(storage, source_key: str, doc: dict) -> None:
+    """Merge one journaled manifest intent into the live manifest on the
+    shared tier (runtime/tiersupervisor.py journal replay).
+
+    Never a blind overwrite: the live L2 doc is read fresh and the
+    journaled variants merge into it BY NAME, so renditions another
+    replica persisted while this one was islanded survive the replay.
+    Same-name collisions are safe either way — variant facts are derived
+    from deterministic content-addressed renders, so both writers hold
+    identical rows. A missing/corrupt/foreign-version live doc falls
+    back to the journaled state alone. Raises on storage failure so the
+    replay loop can abort and re-queue."""
+    live = None
+    try:
+        raw = storage.read(manifest_name(source_key))
+        live = json.loads(raw.decode("utf-8"))
+    except Exception:
+        live = None  # absent or unreadable: the journaled doc stands
+    merged_variants = dict(doc.get("variants") or {})
+    source_mime = str(doc.get("source_mime") or "")
+    if isinstance(live, dict) and live.get("v") == MANIFEST_VERSION:
+        base = dict(live.get("variants") or {})
+        base.update(merged_variants)
+        merged_variants = base
+        source_mime = source_mime or str(live.get("source_mime") or "")
+    storage.write(
+        manifest_name(source_key),
+        json.dumps(
+            {
+                "v": MANIFEST_VERSION,
+                "source_mime": source_mime,
+                "variants": merged_variants,
+            },
+            sort_keys=True,
+        ).encode("utf-8"),
+    )

@@ -18,8 +18,9 @@ per-launch ring + dump inventory), /debug/profile (arm/list/download
 batch-scoped device-profile captures), /debug/brownout (degradation
 level + pressure components), /debug/device (backend supervisor state:
 breaker, probes, failovers), /debug/autotune (online policy, envelopes,
-decision history), POST /debug/fleet/replicas (dynamic replica-set
-reload).
+decision history), /debug/tier (shared-tier outage supervisor: island
+state, journal, scrubber), POST /debug/fleet/replicas (dynamic
+replica-set reload).
 
 plus the ``encrypt`` CLI subcommand (reference app.php:93-96):
 
@@ -89,6 +90,9 @@ MEMBERSHIP_KEY: web.AppKey = web.AppKey("membership", object)
 # fleet observatory (runtime/observatory.py): tests and the observatory
 # smoke reach the digest/rollup/recommender agent through this key
 OBSERVATORY_KEY: web.AppKey = web.AppKey("observatory", object)
+# shared-tier outage supervisor (runtime/tiersupervisor.py): tests and
+# the L2-outage smoke reach the island/journal state machine here
+TIER_SUPERVISOR_KEY: web.AppKey = web.AppKey("tier_supervisor", object)
 
 # routes that run the image pipeline get a trace; infrastructure routes
 # (/metrics scrapes, health probes) would only fill the ring with noise
@@ -398,6 +402,27 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
         brownout=brownout, host_pipeline=host_pipeline,
         device_supervisor=supervisor if supervisor.enabled else None,
     )
+    # shared-tier outage supervisor (runtime/tiersupervisor.py;
+    # docs/resilience.md "Island mode"): watches L2 storage / lease /
+    # membership-marker outcomes for a consecutive-failure STORM, trips
+    # the tier into island mode (every L2 op short-circuits locally,
+    # writes queue in the write-behind journal), re-promotes after clean
+    # probes and replays the journal, and runs the anti-entropy
+    # scrubber. Default off: no feed, no threads, no metrics —
+    # byte-identical serving (pinned by tests/test_tier_supervisor.py).
+    from flyimg_tpu.runtime.tiersupervisor import TierSupervisor
+
+    tier_supervisor = TierSupervisor.from_params(params, metrics=metrics)
+    if tier_supervisor.enabled:
+        tier_supervisor.attach(
+            storage=storage, variant_index=handler.variants
+        )
+        if hasattr(storage, "attach_supervisor"):
+            storage.attach_supervisor(tier_supervisor)
+        if handler.l2lease is not None:
+            handler.l2lease.supervisor = tier_supervisor
+        handler.variants.attach_supervisor(tier_supervisor)
+        tier_supervisor.register_metrics(metrics)
     # state gauges (runtime/metrics.py Gauge): sampled at /metrics render
     inflight = metrics.gauge(
         "flyimg_inflight_requests", "HTTP requests currently in flight"
@@ -532,6 +557,10 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
         warmstart=warmstart if warmstart.enabled else None,
         metrics=metrics,
     )
+    if tier_supervisor.enabled:
+        # islanded heartbeats/listings short-circuit (no marker IO
+        # timeouts) and marker outcomes feed the tier storm counter
+        membership.tier_supervisor = tier_supervisor
     # fleet observatory + autoscale recommender (runtime/observatory.py;
     # docs/fleet.md "Fleet observatory & autoscaling signal"): publish
     # this replica's signal digest on the membership beat, assemble
@@ -552,6 +581,10 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
         supervisor=supervisor if supervisor.enabled else None,
         metrics=metrics,
     )
+    if tier_supervisor.enabled:
+        # islanded beats skip digest IO entirely and mark the cached
+        # rollup stale — degrading loudly instead of timing out quietly
+        observatory.tier_supervisor = tier_supervisor
     if observatory.enabled:
         observatory.window.attach(
             metrics=metrics,
@@ -605,6 +638,8 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
                 # (queued by its worker threads, which have no ambient
                 # trace) land on this request — one list check when idle
                 supervisor.evaluate()
+                # tier island/repromote events drain the same way
+                tier_supervisor.evaluate()
             if trace is not None:
                 trace.root.set_attribute("route", route)
                 trace.root.set_attribute("http.method", request.method)
@@ -700,6 +735,7 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
     app[SUPERVISOR_KEY] = supervisor
     app[MEMBERSHIP_KEY] = membership
     app[OBSERVATORY_KEY] = observatory
+    app[TIER_SUPERVISOR_KEY] = tier_supervisor
 
     # readiness vs liveness: /healthz answers "is the process + device
     # runtime up", /readyz answers "should a load balancer route here".
@@ -738,6 +774,9 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
             warmstart_mod.uninstall()
         observatory.close()  # digest released before the member marker
         membership.close()
+        # after the marker release attempt: an islanded close skips the
+        # marker IO above, and the prober/scrubber threads stop here
+        tier_supervisor.close()
         if injector is not None:
             from flyimg_tpu.testing import faults
 
@@ -752,12 +791,25 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
 
         app.on_startup.append(_start_membership)
 
+    if tier_supervisor.enabled:
+
+        async def _start_tier_supervisor(_app):
+            # the prober only exists while islanded; this starts the
+            # (optional) anti-entropy scrub loop
+            tier_supervisor.start()
+
+        app.on_startup.append(_start_tier_supervisor)
+
     # automatic cache budget: prune least-recently-modified outputs in the
     # background when `cache_max_bytes` is set (local storage only — S3 /
     # GCS deployments use bucket lifecycle policies)
     cache_max = int(params.by_key("cache_max_bytes", 0) or 0)
     # a non-positive interval disables the loop (and can never busy-spin)
     prune_interval = float(params.by_key("cache_prune_interval_s", 300.0))
+    # orphaned .part reclaim rides the same pass (storage/local.py
+    # prune): a writer killed mid-write leaks a temp file invisible to
+    # listing and the size budget — the TTL bounds how long it survives
+    part_ttl = float(params.by_key("cache_part_ttl_s", 3600.0) or 0.0)
     if cache_max > 0 and prune_interval > 0 and hasattr(storage, "prune"):
 
         async def _prune_loop(app_):
@@ -772,7 +824,7 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
                     await asyncio.sleep(prune_interval)
                     try:
                         summary = await loop.run_in_executor(
-                            None, storage.prune, cache_max
+                            None, storage.prune, cache_max, part_ttl
                         )
                     except Exception as exc:
                         # a transient scan error must not silently END
@@ -784,6 +836,12 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
                             "flyimg_cache_pruned_total",
                             "Cached outputs evicted by the size budget",
                         ).inc(summary["deleted"])
+                    if summary.get("parts"):
+                        metrics.counter(
+                            "flyimg_cache_part_orphans_total",
+                            "Orphaned .part temporaries reclaimed by "
+                            "the prune pass",
+                        ).inc(summary["parts"])
 
             task = asyncio.create_task(run())
             yield
@@ -1004,6 +1062,12 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
             # draining (503 above, via on_shutdown) -> gone. Absent
             # entirely with membership off — byte-identical body.
             doc["members"] = int(membership.member_count())
+        if tier_supervisor.enabled:
+            # an islanded replica stays READY (L1 hits and journaled
+            # writes still serve) — the field is for operators and the
+            # L2-outage smoke, not a routing gate. Absent entirely with
+            # the supervisor off — byte-identical body.
+            doc["tier"] = "island" if tier_supervisor.islanded() else "attached"
         return web.Response(
             text=_json.dumps(doc),
             content_type="application/json",
@@ -1311,6 +1375,21 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
             text=_json.dumps(doc), content_type="application/json"
         )
 
+    async def debug_tier(_request: web.Request) -> web.Response:
+        """Shared-tier outage supervisor state (runtime/tiersupervisor.py
+        snapshot; docs/resilience.md "Island mode"): attached/island
+        state, storm counters, probe/flap bookkeeping, journal depth and
+        drop/replay accounting, and the scrubber's purge counts."""
+        import json as _json
+
+        denied = _debug_gate_404()
+        if denied is not None:
+            return denied
+        return web.Response(
+            text=_json.dumps(tier_supervisor.snapshot()),
+            content_type="application/json",
+        )
+
     async def debug_fleet_status(_request: web.Request) -> web.Response:
         """One JSON snapshot of the whole fleet (docs/fleet.md "Fleet
         observatory & autoscaling signal"): every live signal digest,
@@ -1428,6 +1507,7 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
     app.router.add_get("/debug/brownout", debug_brownout)
     app.router.add_get("/debug/device", debug_device)
     app.router.add_get("/debug/autotune", debug_autotune)
+    app.router.add_get("/debug/tier", debug_tier)
     app.router.add_get("/debug/fleet", debug_fleet)
     app.router.add_get("/debug/fleet/status", debug_fleet_status)
     app.router.add_post("/debug/fleet/replicas", debug_fleet_replicas)

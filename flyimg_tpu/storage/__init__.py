@@ -57,7 +57,13 @@ def make_storage(params, metrics=None) -> "Storage":
         l2.retry_policy = retry
         l2.metrics = metrics
         storage.metrics = metrics
-        storage = TieredStorage(storage, l2, metrics=metrics)
+        storage = TieredStorage(
+            storage, l2, metrics=metrics,
+            # blake2b sidecars next to each L2 write-through — the
+            # torn-write witness the anti-entropy scrubber verifies
+            # (runtime/tiersupervisor.py); default off, zero sidecars
+            checksum_enable=bool(params.by_key("l2_checksum_enable", False)),
+        )
     # hedged cache-hit reads (storage/base.py fetch_hedged): after this
     # many ms without a primary result, one backup read fires and the
     # winner serves — bounds the cache-hit tail when the store stalls.

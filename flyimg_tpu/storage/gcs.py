@@ -37,6 +37,22 @@ class GCSStorage(Storage):
         # the standard on GCP hosts (incl. TPU VMs)
         self._client = gcs.Client(project=conf.get("project") or None)
         self._bucket = self._client.bucket(self.bucket_name)
+        # split connect/read timeouts (the fetch-policy contract,
+        # docs/resilience.md): google-cloud-storage takes them per call
+        # as a (connect, read) tuple, not at client construction. 0 =
+        # library default; with both unset no kwarg is passed at all, so
+        # calls are byte-identical (and fakes without a timeout param
+        # keep working).
+        connect_t = float(
+            params.by_key("storage_connect_timeout_s", 0.0) or 0.0
+        )
+        read_t = float(params.by_key("storage_read_timeout_s", 0.0) or 0.0)
+        if connect_t > 0 and read_t > 0:
+            self._call_kwargs = {"timeout": (connect_t, read_t)}
+        elif connect_t > 0 or read_t > 0:
+            self._call_kwargs = {"timeout": connect_t or read_t}
+        else:
+            self._call_kwargs = {}
 
     @staticmethod
     def _is_transient(exc: Exception) -> bool:
@@ -61,7 +77,7 @@ class GCSStorage(Storage):
 
     def has(self, name: str) -> bool:
         try:
-            return self._bucket.blob(name).exists()
+            return self._bucket.blob(name).exists(**self._call_kwargs)
         except Exception as exc:
             if self._is_not_found(exc):
                 return False
@@ -69,13 +85,16 @@ class GCSStorage(Storage):
 
     def read(self, name: str) -> bytes:
         return self._with_retry(
-            "read", lambda: self._bucket.blob(name).download_as_bytes()
+            "read",
+            lambda: self._bucket.blob(name).download_as_bytes(
+                **self._call_kwargs
+            ),
         )
 
     def write(self, name: str, data: bytes) -> Optional[float]:
         def _write():
             blob = self._bucket.blob(name)
-            blob.upload_from_string(data)
+            blob.upload_from_string(data, **self._call_kwargs)
             # upload_from_string refreshes blob metadata from the response:
             # the object's OWN stamp, so hits serve the identical validator
             updated = getattr(blob, "updated", None)
@@ -87,14 +106,14 @@ class GCSStorage(Storage):
 
     def delete(self, name: str) -> None:
         try:
-            self._bucket.blob(name).delete()
+            self._bucket.blob(name).delete(**self._call_kwargs)
         except Exception as exc:
             if not self._is_not_found(exc):
                 raise
 
     def stat(self, name: str) -> Optional[StorageStat]:
         try:
-            blob = self._bucket.get_blob(name)
+            blob = self._bucket.get_blob(name, **self._call_kwargs)
         except Exception as exc:
             if self._is_not_found(exc):
                 return None

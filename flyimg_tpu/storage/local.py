@@ -110,20 +110,44 @@ class LocalStorage(Storage):
         base = os.environ.get("HOSTNAME_URL") or request_base or ""
         return f"{base.rstrip('/')}/{UPLOAD_WEB_DIR}{name}"
 
-    def prune(self, max_bytes: int) -> dict:
+    def prune(self, max_bytes: int, part_ttl_s: float = 0.0) -> dict:
         """Evict least-recently-modified artifacts until the store fits
         ``max_bytes`` (the derived-output cache grows unboundedly in both
         this framework and the reference — every entry is recomputable, so
         eviction is always safe). Strict age cutoff: newest-first
         accumulation stops at the first entry that would overflow the
         budget, and that entry plus everything older is evicted — so every
-        kept artifact is newer than every evicted one. Returns
-        {kept, deleted, bytes} where ``bytes`` is what actually remains on
-        disk (files that failed to delete are counted as kept)."""
+        kept artifact is newer than every evicted one.
+
+        ``part_ttl_s`` > 0 additionally reclaims orphaned ``.part``
+        temporaries older than the TTL: a writer killed between open and
+        ``os.replace`` leaks its temp file forever (it is invisible to
+        listing, eviction, and the size budget), so the prune pass is
+        where they die. The TTL must exceed any sane write duration — an
+        in-flight ``.part`` is always younger than it.
+
+        Returns {kept, deleted, bytes, parts} where ``bytes`` is what
+        actually remains on disk (files that failed to delete are counted
+        as kept) and ``parts`` is the orphan count reclaimed."""
         entries = []
+        parts = 0
+        now = None
         with os.scandir(self.root) as it:
             for entry in it:
-                if not entry.is_file() or entry.name.endswith(".part"):
+                if not entry.is_file():
+                    continue
+                if entry.name.endswith(".part"):
+                    if part_ttl_s > 0:
+                        if now is None:
+                            import time as _time
+
+                            now = _time.time()
+                        try:
+                            if now - entry.stat().st_mtime > part_ttl_s:
+                                os.remove(entry.path)
+                                parts += 1
+                        except OSError:  # racing writer/other prune: skip
+                            pass
                     continue
                 try:
                     st = entry.stat()
@@ -147,4 +171,5 @@ class LocalStorage(Storage):
             except OSError:  # still on disk: report it honestly
                 kept += 1
                 total += size
-        return {"kept": kept, "deleted": deleted, "bytes": total}
+        return {"kept": kept, "deleted": deleted, "bytes": total,
+                "parts": parts}

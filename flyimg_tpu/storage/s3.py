@@ -31,11 +31,31 @@ class S3Storage(Storage):
             raise MissingParamsException(
                 "s3 storage selected but boto3 is not installed"
             ) from exc
+        # split connect/read timeouts (the fetch-policy contract,
+        # docs/resilience.md): a blackholed endpoint must fail at the
+        # connect cap, not botocore's default (60s each). 0 = library
+        # default, and no Config object is built at all — construction
+        # is byte-identical with the knobs unset.
+        client_kwargs = {}
+        connect_t = float(
+            params.by_key("storage_connect_timeout_s", 0.0) or 0.0
+        )
+        read_t = float(params.by_key("storage_read_timeout_s", 0.0) or 0.0)
+        if connect_t > 0 or read_t > 0:
+            from botocore.config import Config as _BotoConfig
+
+            timeouts = {}
+            if connect_t > 0:
+                timeouts["connect_timeout"] = connect_t
+            if read_t > 0:
+                timeouts["read_timeout"] = read_t
+            client_kwargs["config"] = _BotoConfig(**timeouts)
         self._client = boto3.client(
             "s3",
             aws_access_key_id=self.access_id,
             aws_secret_access_key=self.secret_key,
             region_name=self.region,
+            **client_kwargs,
         )
         self._warned_403 = False
 

@@ -50,6 +50,11 @@ LOGGER = "flyimg.fleet"
 #: suffix of the lease marker object a leader writes next to the artifact
 LEASE_SUFFIX = ".lease"
 
+#: suffix of the optional blake2b integrity sidecar written next to each
+#: L2 artifact on write-through (``l2_checksum_enable``); verified by the
+#: anti-entropy scrubber (runtime/tiersupervisor.py)
+CHECKSUM_SUFFIX = ".b2"
+
 #: fleet-membership heartbeat markers (runtime/membership.py) live on the
 #: same shared tier under a reserved flat prefix/suffix pair — flat
 #: because LocalStorage basenames every object name
@@ -78,16 +83,29 @@ def digest_name(slug: str) -> str:
     return f"{DIGEST_PREFIX}{slug}{DIGEST_SUFFIX}"
 
 
+def checksum_name(name: str) -> str:
+    """Storage object name of the blake2b sidecar guarding ``name``."""
+    return f"{name}{CHECKSUM_SUFFIX}"
+
+
 class TieredStorage(Storage):
     """L1 (per-replica) + L2 (fleet-shared) behind the one Storage
     surface the handler consumes. The handler's read-time corrupt-entry
     sniffing applies unchanged to whatever tier served the bytes — and
     its discard deletes both copies."""
 
-    def __init__(self, l1: Storage, l2: Storage, *, metrics=None) -> None:
+    def __init__(
+        self, l1: Storage, l2: Storage, *, metrics=None,
+        checksum_enable: bool = False,
+    ) -> None:
         self._l1 = l1
         self._l2 = l2
         self.metrics = metrics
+        self.checksum_enable = bool(checksum_enable)
+        # optional runtime.tiersupervisor.TierSupervisor wired by the
+        # app AFTER make_storage: feeds it L2 outcomes and obeys its
+        # island short-circuits; None (the default) changes nothing
+        self._supervisor = None
 
     @property
     def shared(self) -> Storage:
@@ -96,16 +114,78 @@ class TieredStorage(Storage):
         themselves (base.Storage.shared), so callers never branch."""
         return self._l2
 
+    # -- tier supervisor wiring (runtime/tiersupervisor.py) ----------------
+
+    def attach_supervisor(self, supervisor) -> None:
+        self._supervisor = supervisor
+
+    def _islanded(self, op: str) -> bool:
+        """True when island mode short-circuits this L2 op (and counts
+        the skip); always False without a supervisor."""
+        sup = self._supervisor
+        if sup is None or not sup.islanded():
+            return False
+        sup.count_skip(op)
+        return True
+
+    def _l2_ok(self) -> None:
+        sup = self._supervisor
+        if sup is not None:
+            sup.record_success("storage")
+
+    def _l2_failed(self) -> None:
+        sup = self._supervisor
+        if sup is not None:
+            sup.record_failure("storage")
+
+    def _journal(self, name: str) -> None:
+        sup = self._supervisor
+        if sup is not None:
+            sup.journal_artifact(name)
+
     # -- reads -------------------------------------------------------------
 
     def has(self, name: str) -> bool:
-        return self._l1.has(name) or self._l2.has(name)
+        """L1 then L2; an L2 failure degrades to the L1 answer (a
+        cross-tier existence check must never fail a request the L1
+        could have served as a miss)."""
+        if self._l1.has(name):
+            return True
+        if self._islanded("has"):
+            return False
+        try:
+            faults.fire("l2.storage", op="has", name=name)
+            found = self._l2.has(name)
+        except Exception as exc:
+            self._l2_failed()
+            logging.getLogger(LOGGER).warning(
+                "L2 existence check of %s failed (answering from L1 "
+                "only): %s", name, exc,
+            )
+            return False
+        self._l2_ok()
+        return found
 
     def stat(self, name: str):
+        """L1 then L2; an L2 failure degrades to absent, the same
+        posture as ``has``/``fetch``."""
         st = self._l1.stat(name)
         if st is not None:
             return st
-        return self._l2.stat(name)
+        if self._islanded("stat"):
+            return None
+        try:
+            faults.fire("l2.storage", op="stat", name=name)
+            st = self._l2.stat(name)
+        except Exception as exc:
+            self._l2_failed()
+            logging.getLogger(LOGGER).warning(
+                "L2 stat of %s failed (answering from L1 only): %s",
+                name, exc,
+            )
+            return None
+        self._l2_ok()
+        return st
 
     def read(self, name: str) -> bytes:
         """L1 then L2, WITHOUT promotion: read() serves mutable shared
@@ -116,12 +196,16 @@ class TieredStorage(Storage):
         try:
             return self._l1.read(name)
         except Exception:
+            if self._islanded("read"):
+                raise  # islanded: the L1 miss IS the answer
             return self._l2.read(name)
 
     def fetch(self, name: str) -> Optional[tuple]:
         got = self._l1.fetch(name)
         if got is not None:
             return got
+        if self._islanded("read"):
+            return None
         try:
             # fault hook (flyimg_tpu/testing/faults.py l2.storage): a
             # raising plan models the shared tier going away mid-read —
@@ -130,10 +214,12 @@ class TieredStorage(Storage):
             faults.fire("l2.storage", op="read", name=name)
             got = self._l2.fetch(name)
         except Exception as exc:
+            self._l2_failed()
             logging.getLogger(LOGGER).warning(
                 "L2 read of %s failed (serving as a miss): %s", name, exc
             )
             return None
+        self._l2_ok()
         if got is None:
             return None
         # promote: derived outputs are content-addressed and their bytes
@@ -156,12 +242,20 @@ class TieredStorage(Storage):
     def write(self, name: str, data: bytes) -> Optional[float]:
         """Write-through: L1 first (the local serve path), then L2. An
         L2 failure degrades to single-replica behavior for this key —
-        counted, logged, never a request failure."""
+        counted, logged, journaled for replay (when the tier supervisor
+        is wired), never a request failure. While islanded the L2 leg
+        is skipped outright: the journal records the debt and the
+        re-promotion replay pays it."""
         mtime = self._l1.write(name, data)
+        if self._islanded("write"):
+            self._journal(name)
+            return mtime
         try:
             faults.fire("l2.storage", op="write", name=name)
             self._l2.write(name, data)
         except Exception as exc:
+            self._l2_failed()
+            self._journal(name)
             if self.metrics is not None:
                 self.metrics.counter(
                     "flyimg_l2_writethrough_failures_total",
@@ -171,16 +265,72 @@ class TieredStorage(Storage):
             logging.getLogger(LOGGER).warning(
                 "L2 write-through of %s failed: %s", name, exc
             )
+            return mtime
+        self._l2_ok()
+        self._write_sidecar(name, data)
         return mtime
 
-    def delete(self, name: str) -> None:
-        self._l1.delete(name)
+    def _write_sidecar(self, name: str, data: bytes) -> None:
+        """Best-effort blake2b sidecar next to a successful L2 write —
+        the torn-write witness the scrubber verifies. Skipped for the
+        sidecars themselves and for fleet plumbing written through this
+        surface (leases/markers go via ``shared`` directly, but guard
+        anyway)."""
+        if not self.checksum_enable or name.endswith(CHECKSUM_SUFFIX):
+            return
+        import hashlib
+
         try:
+            self._l2.write(
+                checksum_name(name),
+                hashlib.blake2b(data).hexdigest().encode("utf-8"),
+            )
+        except Exception as exc:
+            logging.getLogger(LOGGER).warning(
+                "L2 checksum sidecar write for %s failed: %s", name, exc
+            )
+
+    def replay_to_l2(self, name: str) -> bool:
+        """Re-write one journaled artifact into the L2 from its L1 copy
+        (runtime/tiersupervisor.py journal replay). Returns False when
+        the L1 copy is gone (pruned during the island window — nothing
+        left to replay); RAISES on L2 failure so the replay loop can
+        abort and re-queue."""
+        got = self._l1.fetch(name)
+        if got is None:
+            return False
+        data, _stat = got
+        faults.fire("l2.storage", op="replay", name=name)
+        self._l2.write(name, data)
+        self._write_sidecar(name, data)
+        return True
+
+    def delete(self, name: str) -> None:
+        """L1 delete propagates (the caller's tier — a failure there is
+        its problem to surface); the L2 leg is best-effort, so a dead
+        shared tier can never wedge a corrupt-entry discard or an rf_1
+        refresh. The partial-failure residual (L1 gone, L2 copy left)
+        is bounded: a poisoned artifact that resurrects from the L2 is
+        re-sniffed (and re-discarded) at read time, and the scrubber
+        eventually purges it at the source."""
+        self._l1.delete(name)
+        if self._islanded("delete"):
+            return
+        try:
+            faults.fire("l2.storage", op="delete", name=name)
             self._l2.delete(name)
         except Exception as exc:
+            self._l2_failed()
             logging.getLogger(LOGGER).warning(
                 "L2 delete of %s failed: %s", name, exc
             )
+            return
+        self._l2_ok()
+        if self.checksum_enable and not name.endswith(CHECKSUM_SUFFIX):
+            try:
+                self._l2.delete(checksum_name(name))
+            except Exception:
+                pass  # orphan sidecar; the scrubber skips non-artifacts
 
     def public_url(self, name: str, request_base: Optional[str] = None) -> str:
         return self._l1.public_url(name, request_base)
@@ -235,6 +385,12 @@ class L2Lease:
         self.wait_cap_s = float(wait_cap_s)
         self._clock = clock
         self._sleep = sleep
+        # optional runtime.tiersupervisor.TierSupervisor wired by the
+        # app after handler construction: while islanded, acquire()
+        # claims local leadership immediately (dedup degrades to the
+        # per-process single-flight) instead of paying marker IO
+        # against a dead tier
+        self.supervisor = None
         # one unique token per acquisition attempt: the read-back
         # confirm must distinguish our marker from another replica's
         # written in the same race window (replica ids alone cannot —
@@ -290,8 +446,17 @@ class L2Lease:
             return True  # malformed marker: treat as stealable
         return self._clock() - acquired_at > ttl
 
+    def _islanded(self, op: str) -> bool:
+        sup = self.supervisor
+        if sup is None or not sup.islanded():
+            return False
+        sup.count_skip(op)
+        return True
+
     def holder(self, name: str) -> Optional[str]:
         """The replica id holding a LIVE lease on ``name``, or None."""
+        if self._islanded("lease"):
+            return None
         doc = self._read(name)
         if doc is None or self._expired(doc):
             return None
@@ -300,7 +465,13 @@ class L2Lease:
     def acquire(self, name: str) -> Optional[str]:
         """Try to become the leader for ``name``. Returns the winning
         acquisition token (pass to ``release``) or None when another
-        replica holds a live lease."""
+        replica holds a live lease. While islanded, leadership is
+        claimed LOCALLY without marker IO: the per-process single-
+        flight (service/handler._SingleFlight) still coalesces this
+        replica's threads, and the worst cross-replica cost is the one
+        duplicate render the protocol already accepts."""
+        if self._islanded("lease"):
+            return self._token()
         doc = self._read(name)
         if doc is not None and not self._expired(doc):
             return None
@@ -321,11 +492,17 @@ class L2Lease:
         except Exception as exc:
             # an L2 that cannot hold markers degrades to per-process
             # single-flight: claim leadership locally and render
+            sup = self.supervisor
+            if sup is not None:
+                sup.record_failure("lease")
             logging.getLogger(LOGGER).warning(
                 "lease write for %s failed (%s); rendering without "
                 "cross-replica coalescing", name, exc,
             )
             return token
+        sup = self.supervisor
+        if sup is not None:
+            sup.record_success("lease")
         if confirm is None or confirm.get("token") == token:
             # confirm None = a transient read error (or a racing delete)
             # right after our successful write: claim leadership rather
@@ -338,7 +515,11 @@ class L2Lease:
 
     def release(self, name: str, token: str) -> None:
         """Delete OUR marker (identified by ``token``); a marker stolen
-        by another replica in the meantime is left untouched."""
+        by another replica in the meantime is left untouched. Islanded,
+        there is nothing to delete (local leadership wrote no marker;
+        a pre-trip marker the TTL reclaims)."""
+        if self._islanded("lease"):
+            return
         try:
             doc = self._read(name)
             if doc is not None and doc.get("token") != token:

@@ -75,10 +75,16 @@ through:
                         lease IO failing — acquire degrades to an
                         uncoalesced render, never a request failure
     ``l2.storage``      one shared-L2 tier operation (storage/tiered.py
-                        TieredStorage), ctx ``op`` (``read``/``write``)
-                        and ``name``; a raising plan models the shared
-                        tier going away — reads degrade to an L1 miss,
-                        writes to single-replica behavior for that key
+                        TieredStorage + runtime/tiersupervisor.py), ctx
+                        ``op`` (``read``/``write``/``has``/``stat``/
+                        ``delete``/``probe``/``replay``) and ``name``; a
+                        raising plan models the shared tier going away —
+                        reads degrade to an L1 miss, writes to
+                        single-replica behavior for that key, existence
+                        checks to the L1 answer; ``probe`` governs the
+                        tier supervisor's re-promotion probe and
+                        ``replay`` its journal replay, so one plan
+                        scripts a full outage-and-recovery arc
     ``fleet.member``    one membership-marker operation
                         (runtime/membership.py FleetMembership), ctx
                         ``op`` (``read``/``write``/``confirm``/``list``/

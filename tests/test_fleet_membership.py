@@ -337,6 +337,55 @@ def test_heartbeat_write_failure_counts_and_watch_failure_keeps_set(
     assert a.watch() == ["http://a:1", "http://b:2"]
 
 
+def test_view_staleness_gauge_and_expired_view(tmp_path):
+    """A frozen live view (marker listing failing, or island mode) is
+    labeled, not silent: ``view_stale_seconds`` grows from the last
+    successful listing and ``expired_view`` flips once the whole view
+    could have expired unseen (docs/resilience.md)."""
+    store = _store(tmp_path)
+    clock = FakeClock()
+    metrics = MetricsRegistry()
+    a = _member(store, "http://a:1", clock, ttl=15.0, metrics=metrics)
+    # before any successful listing, age counts from construction
+    clock.advance(3.0)
+    assert a.view_stale_seconds() == pytest.approx(3.0)
+    a.announce()
+    assert a.watch() == ["http://a:1"]
+    assert a.view_stale_seconds() == 0.0
+    assert a.expired_view() is False
+    # listings now fail: the view freezes and its age keeps growing
+    def listing_down(**_ctx):
+        raise OSError("listing down")
+
+    faults.install(
+        faults.FaultInjector().plan("fleet.member", listing_down)
+    )
+    try:
+        clock.advance(10.0)
+        assert a.watch() is None
+        assert a.view_stale_seconds() == pytest.approx(10.0)
+        assert a.expired_view() is False  # still inside the TTL
+        clock.advance(6.0)
+        assert a.expired_view() is True  # every marker may have expired
+        doc = a.snapshot()
+        assert doc["view_stale_seconds"] == pytest.approx(16.0)
+        assert doc["expired_view"] is True
+    finally:
+        faults.clear()
+    # the gauge is registered (enabled-only) and reads the same age
+    gauge = metrics._gauges.get("flyimg_fleet_view_stale_seconds")
+    assert gauge is not None
+    # recovery resets the age on the next successful listing
+    assert a.watch() == ["http://a:1"]
+    assert a.view_stale_seconds() == 0.0
+    assert a.expired_view() is False
+    # disabled: always fresh, never expired (off-is-off)
+    off = _member(store, "http://a:1", clock, enabled=False)
+    clock.advance(1000.0)
+    assert off.view_stale_seconds() == 0.0
+    assert off.expired_view() is False
+
+
 # ---------------------------------------------------------------------------
 # warm start: digest validation, seeding, publish merge
 

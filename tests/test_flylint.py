@@ -352,6 +352,88 @@ def test_registry_clean_fixture_passes(tmp_path):
     assert not result.findings
 
 
+def _chaos_fixture(root, campaign, *, suppress=""):
+    _write(root, "flyimg_tpu/testing/faults.py", f"""\
+        KNOWN_POINTS = frozenset({{
+            "covered.point",
+            "gap.point",{suppress}
+        }})
+        """)
+    _write(root, "flyimg_tpu/service/app.py", """\
+        from flyimg_tpu.testing import faults
+
+        def make_app():
+            faults.fire("covered.point")
+            faults.fire("gap.point")
+        """)
+    _write(root, "tools/smoke_chaos.py", f"""\
+        CAMPAIGN_POINTS = {campaign!r}
+        """)
+    return _scan(
+        root, paths=("flyimg_tpu", "tools"), checkers=[RegistryChecker()]
+    )
+
+
+def test_chaos_coverage_gap_and_stale_entry_trip(tmp_path):
+    """A KNOWN_POINTS entry missing from CAMPAIGN_POINTS is a coverage
+    gap (the end-to-end no-failed-requests proof stopped applying to
+    it); a CAMPAIGN_POINTS entry that KNOWN_POINTS never declared is a
+    stale matrix cell that fires nothing."""
+    result = _chaos_fixture(
+        tmp_path, ("covered.point", "ghost.point")
+    )
+    by_rule = {}
+    for f in result.findings:
+        by_rule.setdefault(f.rule, []).append(f)
+    assert [f.message for f in by_rule["chaos-uncovered"]]
+    assert "gap.point" in by_rule["chaos-uncovered"][0].message
+    # anchored at the KNOWN_POINTS declaration, not the campaign matrix,
+    # so the fingerprint survives matrix reordering
+    assert by_rule["chaos-uncovered"][0].path == "flyimg_tpu/testing/faults.py"
+    assert by_rule["chaos-uncovered"][0].symbol == "KNOWN_POINTS"
+    assert "ghost.point" in by_rule["chaos-point-unknown"][0].message
+    assert by_rule["chaos-point-unknown"][0].path == "tools/smoke_chaos.py"
+
+
+def test_chaos_coverage_full_matrix_passes(tmp_path):
+    result = _chaos_fixture(tmp_path, ("covered.point", "gap.point"))
+    assert not [
+        f for f in result.findings if f.rule.startswith("chaos-")
+    ]
+
+
+def test_chaos_coverage_suppression(tmp_path):
+    result = _chaos_fixture(
+        tmp_path, ("covered.point",),
+        suppress="  # flylint: disable=chaos-uncovered",
+    )
+    assert not [
+        f for f in result.findings if f.rule.startswith("chaos-")
+    ]
+    assert result.suppressed == 1
+
+
+def test_chaos_coverage_absent_campaign_is_inert(tmp_path):
+    """Fixture projects without a tools/smoke_chaos.py (every other
+    checker test) must not trip chaos rules — the parity check needs
+    BOTH registries present."""
+    _write(tmp_path, "flyimg_tpu/testing/faults.py", """\
+        KNOWN_POINTS = frozenset({"gap.point"})
+        """)
+    _write(tmp_path, "flyimg_tpu/service/app.py", """\
+        from flyimg_tpu.testing import faults
+
+        def make_app():
+            faults.fire("gap.point")
+        """)
+    result = _scan(
+        tmp_path, paths=("flyimg_tpu",), checkers=[RegistryChecker()]
+    )
+    assert not [
+        f for f in result.findings if f.rule.startswith("chaos-")
+    ]
+
+
 # ---------------------------------------------------------------------------
 # jax hazards checker
 

@@ -255,6 +255,141 @@ def test_s3_write_survives_throttled_stamp_readback(s3):
     assert client.blobs["t.webp"] == b"x"
 
 
+def test_s3_client_timeouts_threaded_from_knobs(monkeypatch):
+    """storage_connect_timeout_s / storage_read_timeout_s reach the boto3
+    client as a botocore Config with SPLIT connect/read timeouts (the
+    fetch-policy contract: a blackholed endpoint fails at the connect
+    cap, not botocore's 60s default). With the knobs unset (0, the
+    default) no Config is built at all — construction byte-identical."""
+    captured = {}
+
+    fake_boto3 = types.ModuleType("boto3")
+
+    def _client(*_a, **kwargs):
+        captured.update(kwargs)
+        return _FakeClient()
+
+    fake_boto3.client = _client
+    monkeypatch.setitem(sys.modules, "boto3", fake_boto3)
+
+    class _RecordingConfig:
+        def __init__(self, **kwargs):
+            self.kwargs = kwargs
+
+    fake_botocore = types.ModuleType("botocore")
+    fake_config = types.ModuleType("botocore.config")
+    fake_config.Config = _RecordingConfig
+    fake_botocore.config = fake_config
+    monkeypatch.setitem(sys.modules, "botocore", fake_botocore)
+    monkeypatch.setitem(sys.modules, "botocore.config", fake_config)
+
+    make_storage(AppParameters(dict(S3_CONF)))
+    assert "config" not in captured  # knobs unset: library defaults
+
+    captured.clear()
+    conf = dict(S3_CONF)
+    conf["storage_connect_timeout_s"] = 2.5
+    conf["storage_read_timeout_s"] = 9.0
+    make_storage(AppParameters(conf))
+    assert captured["config"].kwargs == {
+        "connect_timeout": 2.5, "read_timeout": 9.0
+    }
+
+    captured.clear()
+    conf["storage_read_timeout_s"] = 0.0  # partial: only the set half
+    make_storage(AppParameters(conf))
+    assert captured["config"].kwargs == {"connect_timeout": 2.5}
+
+
+def test_gcs_call_timeouts_threaded_from_knobs(monkeypatch):
+    """The GCS client takes timeouts per call, not at construction: both
+    knobs set -> a (connect, read) tuple on every blob operation; one
+    set -> that scalar; none set -> NO timeout kwarg at all (so fakes
+    and older client versions without the param keep working)."""
+    recorded = []
+
+    class _RecordingBlob:
+        def __init__(self, store, name):
+            self._store, self._name = store, name
+
+        def exists(self, **kwargs):
+            recorded.append(kwargs)
+            return self._name in self._store
+
+        def upload_from_string(self, data, **kwargs):
+            recorded.append(kwargs)
+            if isinstance(data, str):
+                data = data.encode()
+            self._store[self._name] = data
+            self.updated = _s3_now()
+
+        def download_as_bytes(self, **kwargs):
+            recorded.append(kwargs)
+            return self._store[self._name]
+
+        def delete(self, **kwargs):
+            recorded.append(kwargs)
+            self._store.pop(self._name, None)
+
+    class _RecordingBucket:
+        def __init__(self):
+            self.store = {}
+
+        def blob(self, name):
+            return _RecordingBlob(self.store, name)
+
+        def get_blob(self, name, **kwargs):
+            recorded.append(kwargs)
+            if name not in self.store:
+                return None
+            b = _RecordingBlob(self.store, name)
+            b.updated = _s3_now()
+            return b
+
+    bucket = _RecordingBucket()
+    fake_storage = types.ModuleType("google.cloud.storage")
+    fake_storage.Client = lambda project=None: types.SimpleNamespace(
+        bucket=lambda name: bucket
+    )
+    fake_cloud = types.ModuleType("google.cloud")
+    fake_cloud.storage = fake_storage
+    fake_google = types.ModuleType("google")
+    fake_google.cloud = fake_cloud
+    monkeypatch.setitem(sys.modules, "google", fake_google)
+    monkeypatch.setitem(sys.modules, "google.cloud", fake_cloud)
+    monkeypatch.setitem(sys.modules, "google.cloud.storage", fake_storage)
+
+    base = {"storage_system": "gcs", "gcs": {"bucket_name": "imgs"}}
+    storage = make_storage(AppParameters(dict(base)))
+    storage.write("k.webp", b"x")
+    storage.has("k.webp")
+    storage.read("k.webp")
+    storage.stat("k.webp")
+    storage.delete("k.webp")
+    assert recorded and all(kw == {} for kw in recorded)  # off is off
+
+    recorded.clear()
+    both = dict(base)
+    both["storage_connect_timeout_s"] = 2.0
+    both["storage_read_timeout_s"] = 8.0
+    storage = make_storage(AppParameters(both))
+    storage.write("k.webp", b"x")
+    storage.has("k.webp")
+    storage.read("k.webp")
+    storage.stat("k.webp")
+    storage.delete("k.webp")
+    assert recorded and all(
+        kw == {"timeout": (2.0, 8.0)} for kw in recorded
+    )
+
+    recorded.clear()
+    one = dict(base)
+    one["storage_read_timeout_s"] = 8.0
+    storage = make_storage(AppParameters(one))
+    storage.has("k.webp")
+    assert recorded == [{"timeout": 8.0}]
+
+
 def test_local_stat_and_write_mtime(local):
     """stat() answers cached?+when? in one os.stat; write() returns the
     stored mtime so the miss path never re-queries metadata."""
@@ -355,9 +490,34 @@ def test_local_prune_evicts_lru(local, tmp_path):
     (tmp_path / "up" / "x.part").write_bytes(b"tmp")  # in-flight: untouched
 
     summary = local.prune(250)
-    assert summary == {"kept": 2, "deleted": 3, "bytes": 200}
+    assert summary == {"kept": 2, "deleted": 3, "bytes": 200, "parts": 0}
     kept = sorted(os.listdir(tmp_path / "up"))
     assert kept == ["art3.jpg", "art4.jpg", "x.part"]
+
+
+def test_local_prune_reclaims_aged_part_orphans(local, tmp_path):
+    """A writer killed between open and os.replace leaks its .part temp
+    forever (invisible to listing, eviction, and the size budget) — the
+    prune pass reclaims orphans older than the TTL while leaving young
+    (possibly in-flight) .part files and completed artifacts alone."""
+    import os
+    import time
+
+    local.write("keep.jpg", bytes(10))
+    (tmp_path / "up" / "orphan.jpg.part").write_bytes(b"dead")
+    stamp = time.time() - 7200
+    os.utime(tmp_path / "up" / "orphan.jpg.part", (stamp, stamp))
+    (tmp_path / "up" / "young.jpg.part").write_bytes(b"in-flight")
+
+    # TTL unset (default): orphans are untouched — off is off
+    summary = local.prune(1_000_000)
+    assert summary["parts"] == 0
+    assert (tmp_path / "up" / "orphan.jpg.part").exists()
+
+    summary = local.prune(1_000_000, part_ttl_s=3600.0)
+    assert summary == {"kept": 1, "deleted": 0, "bytes": 10, "parts": 1}
+    names = sorted(os.listdir(tmp_path / "up"))
+    assert names == ["keep.jpg", "young.jpg.part"]
 
 
 def test_prune_cli(tmp_path, capsys):
@@ -391,7 +551,7 @@ def test_local_prune_strict_age_cutoff(local, tmp_path):
         os.utime(local._path(f"c{i}.jpg"), (stamp, stamp))
     summary = local.prune(100)
     # newest (200B) overflows immediately -> strict cutoff evicts all
-    assert summary == {"kept": 0, "deleted": 3, "bytes": 0}
+    assert summary == {"kept": 0, "deleted": 3, "bytes": 0, "parts": 0}
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,648 @@
+"""Shared-tier supervisor (runtime/tiersupervisor.py; docs/resilience.md
+"Shared-tier outage survival"): storm-detection threshold math under an
+injectable clock, island-mode short-circuits through TieredStorage and
+L2Lease, the write-behind journal's dedup/overflow/TTL bounds, journal
+replay at re-promotion (success, requeue-on-failure, missing-L1 drop),
+flap damping, the anti-entropy scrubber's verdicts and purges, and the
+default-off byte identity."""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from flyimg_tpu.appconfig import AppParameters
+from flyimg_tpu.codecs import encode
+from flyimg_tpu.runtime.metrics import MetricsRegistry
+from flyimg_tpu.runtime.tiersupervisor import (
+    ATTACHED,
+    ISLAND,
+    TierSupervisor,
+    probe_name,
+    verify_artifact,
+)
+from flyimg_tpu.runtime.variantindex import MANIFEST_VERSION, manifest_name
+from flyimg_tpu.storage.local import LocalStorage
+from flyimg_tpu.storage.tiered import L2Lease, TieredStorage, checksum_name
+from flyimg_tpu.testing import faults
+
+
+def _run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+class FakeClock:
+    def __init__(self, start: float = 1000.0) -> None:
+        self.now = start
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, seconds: float) -> None:
+        self.now += seconds
+
+
+def _local(root) -> LocalStorage:
+    return LocalStorage(AppParameters({"upload_dir": str(root)}))
+
+
+def _supervisor(clock=None, *, threshold=3, window_s=10.0, hysteresis=2,
+                metrics=None, **kw):
+    sup = TierSupervisor(
+        enabled=True,
+        storm_threshold=threshold,
+        storm_window_s=window_s,
+        probe_hysteresis=hysteresis,
+        probe_interval_s=0.05,
+        metrics=metrics,
+        clock=clock or FakeClock(),
+        **kw,
+    )
+    # no background prober — probes are driven explicitly by the tests
+    sup._ensure_prober = lambda: None
+    return sup
+
+
+def _tiered_with_supervisor(tmp_path, sup, *, checksum_enable=False):
+    l1 = _local(tmp_path / "l1")
+    l2 = _local(tmp_path / "l2")
+    tiered = TieredStorage(l1, l2, checksum_enable=checksum_enable)
+    tiered.attach_supervisor(sup)
+    sup.attach(storage=tiered)
+    return tiered, l1, l2
+
+
+def _trip(sup):
+    for _ in range(sup.storm_threshold):
+        sup.record_failure("storage")
+    assert sup.islanded()
+
+
+def _counter(metrics, name):
+    counter = metrics._counters.get(name)
+    return counter.value if counter is not None else 0.0
+
+
+def _png_bytes(seed=7):
+    rng = np.random.default_rng(seed)
+    return encode(rng.integers(0, 230, (8, 8, 3), dtype=np.uint8), "png")
+
+
+# ---------------------------------------------------------------------------
+# storm-detection threshold math (injectable clock)
+
+
+def test_storm_trips_at_threshold_within_window():
+    metrics = MetricsRegistry()
+    sup = _supervisor(threshold=3, metrics=metrics)
+    sup.record_failure("storage")
+    sup.record_failure("lease")
+    assert sup.state() == ATTACHED  # one short of the threshold
+    sup.record_failure("membership")
+    assert sup.state() == ISLAND
+    assert sup.islanded()
+    assert _counter(
+        metrics, 'flyimg_tier_transitions_total{to="island"}'
+    ) == 1.0
+    # the last failure site is kept for the debug snapshot
+    assert sup.snapshot()["storm"]["last_failure_site"] == "membership"
+
+
+def test_success_resets_the_consecutive_streak():
+    sup = _supervisor(threshold=3)
+    for _ in range(5):
+        sup.record_failure("storage")
+        sup.record_success("storage")  # a recovering tier is not a storm
+    assert sup.state() == ATTACHED
+
+
+def test_failures_spread_past_the_window_do_not_trip():
+    clock = FakeClock()
+    sup = _supervisor(clock, threshold=3, window_s=10.0)
+    sup.record_failure("storage")
+    clock.advance(11.0)
+    sup.record_failure("storage")
+    clock.advance(11.0)
+    # consecutive says 3, but only ONE failure is inside the window —
+    # a slow trickle is the per-op degrade paths' job, not a storm
+    sup.record_failure("storage")
+    assert sup.state() == ATTACHED
+    sup.record_failure("storage")
+    sup.record_failure("storage")
+    assert sup.state() == ISLAND
+
+
+def test_disabled_supervisor_records_nothing():
+    sup = TierSupervisor(enabled=False, clock=FakeClock())
+    for _ in range(20):
+        sup.record_failure("storage")
+    assert not sup.islanded()
+    assert sup.state() == ATTACHED
+    sup.journal_artifact("a.png")
+    sup.journal_manifest("src", {"v": 1})
+    assert sup.journal_snapshot() == []
+
+
+# ---------------------------------------------------------------------------
+# island-mode short-circuits
+
+
+def test_island_short_circuits_tiered_storage(tmp_path):
+    metrics = MetricsRegistry()
+    sup = _supervisor(metrics=metrics)
+    tiered, l1, l2 = _tiered_with_supervisor(tmp_path, sup)
+    _trip(sup)
+    # write: L1 only, journaled for replay
+    tiered.write("a.png", b"bytes")
+    assert l1.read("a.png") == b"bytes"
+    assert not l2.has("a.png")
+    assert [e["name"] for e in sup.journal_snapshot()
+            if e["kind"] == "artifact"] == ["a.png"]
+    # reads degrade to the L1 answer without touching the L2
+    l2.write("only-l2.png", b"remote")
+    assert tiered.has("only-l2.png") is False
+    assert tiered.fetch("only-l2.png") is None
+    assert tiered.stat("only-l2.png") is None
+    # every skip is counted by op
+    assert _counter(
+        metrics, 'flyimg_tier_island_skips_total{op="write"}'
+    ) == 1.0
+    assert _counter(
+        metrics, 'flyimg_tier_island_skips_total{op="has"}'
+    ) == 1.0
+    assert _counter(
+        metrics, 'flyimg_tier_island_skips_total{op="read"}'
+    ) == 1.0
+    assert sup.snapshot()["island_skips"] >= 4
+
+
+def test_island_lease_claims_local_leadership(tmp_path):
+    sup = _supervisor()
+    l2 = _local(tmp_path / "l2")
+    lease = L2Lease(l2, "replica-a")
+    lease.supervisor = sup
+    _trip(sup)
+    token = lease.acquire("a.png")
+    assert token  # local leadership, immediately
+    # no marker IO happened against the dead tier
+    assert list(l2.list_names("")) == []
+    assert lease.holder("a.png") is None
+    lease.release("a.png", token)  # nothing to delete; must not raise
+    assert list(l2.list_names("")) == []
+
+
+def test_pre_trip_write_failure_journals_for_replay(tmp_path):
+    """A write-through failure BEFORE the trip still records the debt —
+    the journal is not island-gated."""
+    sup = _supervisor()
+    tiered, l1, l2 = _tiered_with_supervisor(tmp_path, sup)
+    injector = faults.FaultInjector()
+
+    def boom(**ctx):
+        if ctx.get("op") == "write":
+            raise OSError("bucket down")
+
+    injector.plan("l2.storage", boom)
+    faults.install(injector)
+    try:
+        tiered.write("a.png", b"bytes")
+    finally:
+        faults.clear()
+    assert l1.read("a.png") == b"bytes"
+    assert [e["name"] for e in sup.journal_snapshot()] == ["a.png"]
+    assert sup.state() == ATTACHED  # one failure is not a storm
+
+
+# ---------------------------------------------------------------------------
+# write-behind journal bounds
+
+
+def test_journal_dedups_by_key_keeping_newest():
+    sup = _supervisor()
+    sup.journal_artifact("hot.png")
+    sup.journal_artifact("hot.png")
+    sup.journal_manifest("src", {"v": 1, "variants": {"a": {}}})
+    sup.journal_manifest("src", {"v": 1, "variants": {"a": {}, "b": {}}})
+    entries = sup.journal_snapshot()
+    assert len(entries) == 2
+    manifest = [e for e in entries if e["kind"] == "manifest"][0]
+    assert set(manifest["doc"]["variants"]) == {"a", "b"}
+
+
+def test_journal_overflow_drops_oldest_and_counts():
+    metrics = MetricsRegistry()
+    sup = _supervisor(metrics=metrics, journal_max_entries=2)
+    sup.journal_artifact("one.png")
+    sup.journal_artifact("two.png")
+    sup.journal_artifact("three.png")
+    names = [e["name"] for e in sup.journal_snapshot()]
+    assert names == ["two.png", "three.png"]
+    assert _counter(
+        metrics, 'flyimg_tier_journal_dropped_total{reason="overflow"}'
+    ) == 1.0
+    assert sup.snapshot()["journal"]["dropped"] == 1
+
+
+def test_journal_ttl_expires_stale_entries_at_drain():
+    clock = FakeClock()
+    metrics = MetricsRegistry()
+    sup = _supervisor(clock, metrics=metrics, journal_ttl_s=100.0)
+    sup.journal_artifact("stale.png")
+    clock.advance(101.0)
+    sup.journal_artifact("fresh.png")
+    live = sup._journal_drain()
+    assert [e["name"] for e in live] == ["fresh.png"]
+    assert _counter(
+        metrics, 'flyimg_tier_journal_dropped_total{reason="expired"}'
+    ) == 1.0
+
+
+# ---------------------------------------------------------------------------
+# probed re-promotion + journal replay
+
+
+def test_repromotion_replays_journal_then_reattaches(tmp_path):
+    metrics = MetricsRegistry()
+    sup = _supervisor(metrics=metrics, hysteresis=2)
+    tiered, l1, l2 = _tiered_with_supervisor(tmp_path, sup)
+    _trip(sup)
+    tiered.write("a.png", b"island-render")
+    doc = {
+        "v": MANIFEST_VERSION, "source_mime": "image/png",
+        "variants": {"w_32": {"stub": True}},
+    }
+    sup.journal_manifest("srckey", doc)
+    # first clean probe: hysteresis not yet met, still islanded
+    assert sup.probe_and_handle() is True
+    assert sup.state() == ISLAND
+    assert not l2.has("a.png")
+    # second clean probe: replay, then re-attach
+    assert sup.probe_and_handle() is True
+    assert sup.state() == ATTACHED
+    assert l2.read("a.png") == b"island-render"
+    merged = json.loads(l2.read(manifest_name("srckey")).decode("utf-8"))
+    assert merged["variants"] == {"w_32": {"stub": True}}
+    assert sup.journal_snapshot() == []
+    assert _counter(
+        metrics, 'flyimg_tier_journal_replayed_total{kind="artifact"}'
+    ) == 1.0
+    assert _counter(
+        metrics, 'flyimg_tier_journal_replayed_total{kind="manifest"}'
+    ) == 1.0
+    assert _counter(
+        metrics, 'flyimg_tier_transitions_total{to="attached"}'
+    ) == 1.0
+    assert _counter(
+        metrics, 'flyimg_tier_probe_total{outcome="ok"}'
+    ) == 2.0
+    # the probe scratch object was cleaned up
+    assert not l2.has(probe_name(""))
+
+
+def test_manifest_replay_merges_with_live_doc_by_variant_name(tmp_path):
+    """A manifest another replica wrote while this one was islanded
+    survives the replay — merge by name, never a blind overwrite."""
+    sup = _supervisor(hysteresis=1)
+    tiered, l1, l2 = _tiered_with_supervisor(tmp_path, sup)
+    _trip(sup)
+    sup.journal_manifest("srckey", {
+        "v": MANIFEST_VERSION, "source_mime": "image/png",
+        "variants": {"mine": {"who": "islanded"}},
+    })
+    # meanwhile another replica persisted its own rendition
+    l2.write(manifest_name("srckey"), json.dumps({
+        "v": MANIFEST_VERSION, "source_mime": "image/png",
+        "variants": {"theirs": {"who": "remote"}},
+    }).encode("utf-8"))
+    assert sup.probe_and_handle() is True
+    assert sup.state() == ATTACHED
+    merged = json.loads(l2.read(manifest_name("srckey")).decode("utf-8"))
+    assert set(merged["variants"]) == {"mine", "theirs"}
+
+
+def test_replay_failure_requeues_and_stays_islanded(tmp_path):
+    sup = _supervisor(hysteresis=1)
+    tiered, l1, l2 = _tiered_with_supervisor(tmp_path, sup)
+    _trip(sup)
+    tiered.write("a.png", b"bytes")
+    injector = faults.FaultInjector()
+
+    def fail_replay(**ctx):
+        if ctx.get("op") == "replay":
+            raise OSError("still down for big writes")
+
+    injector.plan("l2.storage", fail_replay)
+    faults.install(injector)
+    try:
+        # the probe passes (tiny object) but the replay aborts —
+        # the journal survives and the island state holds
+        assert sup.probe_and_handle() is True
+        assert sup.state() == ISLAND
+        assert [e["name"] for e in sup.journal_snapshot()] == ["a.png"]
+        assert sup.snapshot()["probe"]["clean_probes"] == 0
+    finally:
+        faults.clear()
+    # tier actually healed: the next probe replays and re-attaches
+    assert sup.probe_and_handle() is True
+    assert sup.state() == ATTACHED
+    assert l2.read("a.png") == b"bytes"
+
+
+def test_replay_drops_entries_whose_l1_copy_was_pruned(tmp_path):
+    metrics = MetricsRegistry()
+    sup = _supervisor(metrics=metrics, hysteresis=1)
+    tiered, l1, l2 = _tiered_with_supervisor(tmp_path, sup)
+    _trip(sup)
+    sup.journal_artifact("pruned-away.png")  # no L1 copy exists
+    assert sup.probe_and_handle() is True
+    assert sup.state() == ATTACHED  # a missing entry never wedges replay
+    assert not l2.has("pruned-away.png")
+    assert _counter(
+        metrics, 'flyimg_tier_journal_dropped_total{reason="missing"}'
+    ) == 1.0
+
+
+def test_dead_probe_resets_clean_streak(tmp_path):
+    sup = _supervisor(hysteresis=2)
+    tiered, l1, l2 = _tiered_with_supervisor(tmp_path, sup)
+    _trip(sup)
+    assert sup.probe_and_handle() is True
+    injector = faults.FaultInjector()
+
+    def boom(**ctx):
+        if ctx.get("op") == "probe":
+            raise OSError("flapping")
+
+    injector.plan("l2.storage", boom)
+    faults.install(injector)
+    try:
+        assert sup.probe_and_handle() is False
+    finally:
+        faults.clear()
+    assert sup.state() == ISLAND
+    assert sup.snapshot()["probe"]["clean_probes"] == 0
+    # two clean probes are needed again from scratch
+    assert sup.probe_and_handle() is True
+    assert sup.state() == ISLAND
+    assert sup.probe_and_handle() is True
+    assert sup.state() == ATTACHED
+
+
+def test_flap_damping_doubles_required_clean_probes(tmp_path):
+    clock = FakeClock()
+    sup = _supervisor(clock, hysteresis=1)
+    tiered, l1, l2 = _tiered_with_supervisor(tmp_path, sup)
+    _trip(sup)
+    assert sup.probe_and_handle() is True
+    assert sup.state() == ATTACHED
+    # the re-promotion does not stick: a re-trip within the flap window
+    # doubles the clean probes required next time
+    _trip(sup)
+    assert sup.snapshot()["probe"]["hysteresis_mult"] == 2
+    assert sup.probe_and_handle() is True
+    assert sup.state() == ISLAND  # 1 of 2 required
+    assert sup.probe_and_handle() is True
+    assert sup.state() == ATTACHED
+    # a trip after a long healthy stretch resets the multiplier
+    clock.advance(sup.flap_window_s + 1.0)
+    _trip(sup)
+    assert sup.snapshot()["probe"]["hysteresis_mult"] == 1
+
+
+def test_probe_without_storage_records_never_crashes():
+    sup = _supervisor()
+    ok, detail = sup.probe()
+    assert (ok, detail) == (False, "unattached")
+    _trip(sup)
+    assert sup.probe_and_handle() is False
+    assert sup.snapshot()["probe"]["last_outcome"] == "unattached"
+
+
+def test_probe_torn_read_is_dead(tmp_path):
+    class TornL2(LocalStorage):
+        def read(self, name):
+            return b"not what was written"
+
+    sup = _supervisor()
+    l2 = TornL2(AppParameters({"upload_dir": str(tmp_path / "l2")}))
+    sup.attach(storage=TieredStorage(_local(tmp_path / "l1"), l2))
+    ok, detail = sup.probe()
+    assert (ok, detail) == (False, "torn-read")
+
+
+# ---------------------------------------------------------------------------
+# artifact integrity verdicts + the anti-entropy scrubber
+
+
+def test_verify_artifact_verdicts():
+    png = _png_bytes()
+    assert verify_artifact("a.png", b"", None) == "empty"
+    assert verify_artifact("a.png", png, None) is None
+    # wrong container behind a servable extension
+    assert verify_artifact("a.jpg", png, None) == "magic"
+    # unknown extensions fail open — the sniff cannot judge them
+    assert verify_artifact("blob.xyz", b"arbitrary", None) is None
+    good = hashlib.blake2b(png).hexdigest().encode("utf-8")
+    assert verify_artifact("a.png", png, good) is None
+    bad = hashlib.blake2b(b"other").hexdigest().encode("utf-8")
+    assert verify_artifact("a.png", png, bad) == "checksum"
+    # an empty sidecar judges nothing
+    assert verify_artifact("a.png", png, b"") is None
+
+
+class _RecordingIndex:
+    def __init__(self):
+        self.discarded = []
+
+    def discard_name(self, name):
+        self.discarded.append(name)
+
+
+def test_scrub_purges_corrupt_artifact_from_both_tiers(tmp_path):
+    metrics = MetricsRegistry()
+    sup = _supervisor(metrics=metrics, scrub_enable=True, scrub_sample=16)
+    tiered, l1, l2 = _tiered_with_supervisor(tmp_path, sup)
+    index = _RecordingIndex()
+    sup.attach(storage=tiered, variant_index=index)
+    png = _png_bytes()
+    tiered.write("good.png", png)
+    tiered.write("torn.png", b"\x00garbage that sniffs as nothing")
+    # fleet plumbing on the same tier is never sampled
+    l2.write("a.png.lease", b"{}")
+    l2.write("fleet-member--x.member", b"{}")
+    result = sup.scrub_once()
+    assert result == {"scanned": 2, "purged": 1, "unreadable": 0}
+    assert not l2.has("torn.png")
+    assert not l1.has("torn.png")  # purged from BOTH tiers
+    assert l2.read("good.png") == png
+    assert index.discarded == ["torn.png"]
+    assert _counter(
+        metrics, 'flyimg_tier_scrubbed_total{outcome="clean"}'
+    ) == 1.0
+    assert _counter(
+        metrics, 'flyimg_tier_scrubbed_total{outcome="purged-magic"}'
+    ) == 1.0
+    assert sup.snapshot()["scrub"]["purged"] == 1
+
+
+def test_scrub_checksum_sidecar_catches_silent_corruption(tmp_path):
+    """Valid-container bytes that do not match their write-time blake2b
+    sidecar are purged — the torn-write case a magic sniff passes."""
+    metrics = MetricsRegistry()
+    sup = _supervisor(metrics=metrics, scrub_enable=True)
+    tiered, l1, l2 = _tiered_with_supervisor(
+        tmp_path, sup, checksum_enable=True
+    )
+    tiered.write("a.png", _png_bytes(1))
+    # the L2 copy is silently replaced by different (but valid) bytes
+    l2.write("a.png", _png_bytes(2))
+    result = sup.scrub_once()
+    assert result["purged"] == 1
+    assert not l2.has("a.png")
+    assert not l2.has(checksum_name("a.png"))  # sidecar purged too
+    assert _counter(
+        metrics, 'flyimg_tier_scrubbed_total{outcome="purged-checksum"}'
+    ) == 1.0
+
+
+def test_scrub_respects_sample_bound(tmp_path):
+    sup = _supervisor(scrub_enable=True, scrub_sample=3)
+    tiered, l1, l2 = _tiered_with_supervisor(tmp_path, sup)
+    for i in range(10):
+        l2.write(f"art-{i}.png", _png_bytes())
+    assert sup.scrub_once()["scanned"] == 3
+
+
+def test_scrub_list_failure_feeds_storm_detector(tmp_path):
+    class DeadList(LocalStorage):
+        def list_names(self, prefix):
+            raise OSError("bucket down")
+
+    sup = _supervisor(scrub_enable=True)
+    l2 = DeadList(AppParameters({"upload_dir": str(tmp_path / "l2")}))
+    sup.attach(storage=TieredStorage(_local(tmp_path / "l1"), l2))
+    assert sup.scrub_once() == {"scanned": 0, "purged": 0, "unreadable": 0}
+    assert sup.snapshot()["storm"]["consecutive_failures"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the /debug/tier surface and the default-off byte identity
+
+
+def _write_src(tmp_path):
+    rng = np.random.default_rng(11)
+    src = tmp_path / "src.png"
+    src.write_bytes(
+        encode(rng.integers(0, 230, (48, 64, 3), dtype=np.uint8), "png")
+    )
+    return str(src)
+
+
+def _app_params(tmp_path, sub, **extra):
+    conf = {
+        "tmp_dir": str(tmp_path / sub / "t"),
+        "upload_dir": str(tmp_path / sub / "u"),
+        "batch_deadline_ms": 1.0,
+    }
+    conf.update(extra)
+    return AppParameters(conf)
+
+
+def test_default_off_is_byte_identical(tmp_path):
+    """Supervisor off (the default): no tier metrics, no readyz tier
+    field, no supervisor reference anywhere on the storage path."""
+    from flyimg_tpu.service.app import HANDLER_KEY, make_app
+
+    src = _write_src(tmp_path)
+
+    async def go():
+        app = make_app(_app_params(tmp_path, "plain"))
+        assert app[HANDLER_KEY].variants._supervisor is None
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            ready = await (await client.get("/readyz")).text()
+            assert json.loads(ready) == {"status": "ok"}
+            resp = await client.get(f"/upload/w_32,o_png/{src}")
+            assert resp.status == 200
+            metrics = await (await client.get("/metrics")).text()
+            assert "flyimg_tier_" not in metrics
+            assert (await client.get("/debug/tier")).status == 404
+        finally:
+            await client.close()
+
+    _run(go())
+
+
+def test_debug_tier_gated_and_snapshots(tmp_path):
+    from flyimg_tpu.service.app import TIER_SUPERVISOR_KEY, make_app
+
+    async def go():
+        gated = make_app(_app_params(
+            tmp_path, "gated", tier_supervisor_enable=True,
+        ))
+        on = make_app(_app_params(
+            tmp_path, "on", debug=True, tier_supervisor_enable=True,
+        ))
+        c_gated = TestClient(TestServer(gated))
+        c_on = TestClient(TestServer(on))
+        await c_gated.start_server()
+        await c_on.start_server()
+        try:
+            assert (await c_gated.get("/debug/tier")).status == 404
+            resp = await c_on.get("/debug/tier")
+            assert resp.status == 200
+            doc = json.loads(await resp.text())
+            assert doc["enabled"] is True
+            assert doc["state"] == "attached"
+            assert doc["storm"]["threshold"] == 5
+            assert doc["journal"]["depth"] == 0
+            ready = json.loads(
+                await (await c_on.get("/readyz")).text()
+            )
+            assert ready["tier"] == "attached"
+            metrics = await (await c_on.get("/metrics")).text()
+            assert "flyimg_tier_attached 1" in metrics
+            assert "flyimg_tier_journal_depth 0" in metrics
+            # islanding flips the readyz field and the gauge
+            sup = on[TIER_SUPERVISOR_KEY]
+            with sup._lock:
+                sup._state = ISLAND
+            ready = json.loads(
+                await (await c_on.get("/readyz")).text()
+            )
+            assert ready["tier"] == "island"
+            metrics = await (await c_on.get("/metrics")).text()
+            assert "flyimg_tier_attached 0" in metrics
+        finally:
+            await c_gated.close()
+            await c_on.close()
+
+    _run(go())
+
+
+def test_snapshot_shape():
+    sup = _supervisor()
+    doc = sup.snapshot()
+    assert set(doc) == {
+        "enabled", "state", "state_age_s", "storm", "probe", "journal",
+        "scrub", "island_skips", "trips", "repromotions",
+    }
+    assert set(doc["storm"]) == {
+        "threshold", "window_s", "consecutive_failures",
+        "window_failures", "last_failure_site",
+    }
+    assert set(doc["probe"]) == {
+        "interval_s", "hysteresis", "hysteresis_mult", "clean_probes",
+        "last_outcome", "total",
+    }
+    json.dumps(doc)  # the /debug/tier document must serialize

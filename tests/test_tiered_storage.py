@@ -99,6 +99,76 @@ def test_delete_removes_both_copies(tmp_path):
     tiered.delete("a.png")
 
 
+class _BrokenL2(LocalStorage):
+    """A shared tier whose every remote op raises — the dead-bucket
+    stand-in for the tier-failure semantics tests."""
+
+    def has(self, name):
+        raise OSError("bucket down")
+
+    def stat(self, name):
+        raise OSError("bucket down")
+
+    def delete(self, name):
+        raise OSError("bucket down")
+
+
+def _broken_l2_tiered(tmp_path):
+    l1 = _local(tmp_path / "l1")
+    l2 = _BrokenL2(AppParameters({"upload_dir": str(tmp_path / "l2")}))
+    return TieredStorage(l1, l2), l1, l2
+
+
+def test_has_l2_failure_degrades_to_l1_answer(tmp_path):
+    """A cross-tier existence check must never fail a request the L1
+    could have answered: an L1 hit short-circuits (the broken L2 is
+    never consulted), and on an L1 miss the L2 failure reads as
+    absent — the same single-replica degradation as fetch()."""
+    tiered, l1, _ = _broken_l2_tiered(tmp_path)
+    l1.write("a.png", b"x")
+    assert tiered.has("a.png") is True  # L1 short-circuit, no L2 touch
+    assert tiered.has("missing.png") is False  # absorbed, not raised
+
+
+def test_stat_l2_failure_degrades_to_absent(tmp_path):
+    tiered, l1, _ = _broken_l2_tiered(tmp_path)
+    l1.write("a.png", b"x")
+    assert tiered.stat("a.png") is not None
+    assert tiered.stat("missing.png") is None  # absorbed, not raised
+
+
+def test_delete_l2_failure_absorbed_l1_copy_still_removed(tmp_path):
+    """The partial-failure edge the lease release path depends on: a
+    dead shared tier must not wedge a local discard. The L1 copy goes;
+    the orphaned L2 copy is the documented residual (re-sniffed at read
+    time, eventually purged by the scrubber)."""
+    tiered, l1, l2 = _broken_l2_tiered(tmp_path)
+    l1.write("a.png", b"x")
+    LocalStorage.write(l2, "a.png", b"x")
+    tiered.delete("a.png")  # must not raise
+    assert not l1.has("a.png")
+    assert LocalStorage.has(l2, "a.png")  # residual, by contract
+
+
+def test_delete_l1_failure_propagates(tmp_path):
+    """The caller's own tier failing is its problem to surface — an L1
+    delete error must NOT be silently swallowed (the caller would
+    believe a poisoned artifact is gone while it keeps serving), and
+    the L2 leg must not run after it."""
+
+    class BrokenL1(LocalStorage):
+        def delete(self, name):
+            raise OSError("disk fault")
+
+    l1 = BrokenL1(AppParameters({"upload_dir": str(tmp_path / "l1")}))
+    l2 = _local(tmp_path / "l2")
+    tiered = TieredStorage(l1, l2)
+    l2.write("a.png", b"x")
+    with pytest.raises(OSError):
+        tiered.delete("a.png")
+    assert l2.has("a.png")  # L2 leg never ran
+
+
 def test_read_prefers_l1_and_never_promotes(tmp_path):
     """read() serves mutable shared state (manifests): promoting an L2
     read into L1 would freeze this replica on a stale copy the moment

@@ -20,6 +20,10 @@ The project carries four registries that nothing type-checks:
   ``flyimg_tpu/exceptions.py`` must have an explicit status in
   ``service/app.py``'s ``_ERROR_STATUS`` (and every mapped class must
   exist) — an unmapped class silently falls through as a 500.
+- **chaos coverage**: every ``KNOWN_POINTS`` fault point must appear in
+  ``tools/smoke_chaos.py``'s ``CAMPAIGN_POINTS`` matrix (or carry a
+  baseline justification) — a declared point the chaos campaign never
+  drives is resilience behavior CI stopped proving end-to-end.
 """
 
 from __future__ import annotations
@@ -40,6 +44,7 @@ APPCONFIG = "flyimg_tpu/appconfig.py"
 FAULTS = "flyimg_tpu/testing/faults.py"
 EXCEPTIONS = "flyimg_tpu/exceptions.py"
 APP = "flyimg_tpu/service/app.py"
+CHAOS = "tools/smoke_chaos.py"
 OPTIONS_DOC = "docs/application-options.md"
 OBSERVABILITY_DOC = "docs/observability.md"
 
@@ -54,6 +59,8 @@ RULE_METRIC_INCONSISTENT = "metric-inconsistent"
 RULE_METRIC_DOC_PARITY = "metrics-doc-parity"
 RULE_EXC_UNMAPPED = "exception-unmapped"
 RULE_EXC_UNKNOWN = "exception-map-unknown"
+RULE_CHAOS_UNCOVERED = "chaos-uncovered"
+RULE_CHAOS_UNKNOWN = "chaos-point-unknown"
 
 _METRIC_METHODS = {"counter": "counter", "gauge": "gauge",
                    "histogram": "histogram"}
@@ -122,11 +129,19 @@ class RegistryChecker:
         RULE_EXC_UNKNOWN: (
             "_ERROR_STATUS maps a class that exceptions.py does not define"
         ),
+        RULE_CHAOS_UNCOVERED: (
+            "a KNOWN_POINTS fault point is not driven by the chaos "
+            "campaign matrix (tools/smoke_chaos.py CAMPAIGN_POINTS)"
+        ),
+        RULE_CHAOS_UNKNOWN: (
+            "CAMPAIGN_POINTS lists a point KNOWN_POINTS does not declare"
+        ),
     }
 
     def run(self, project: Project) -> Iterable[Finding]:
         yield from self._check_knobs(project)
         yield from self._check_faults(project)
+        yield from self._check_chaos_coverage(project)
         yield from self._check_metrics(project)
         yield from self._check_exceptions(project)
 
@@ -329,6 +344,67 @@ class RegistryChecker:
                     message=(
                         f"declared fault point `{point}` is never fired "
                         "by any scanned pipeline code"
+                    ),
+                )
+
+    # -- chaos campaign coverage -------------------------------------------
+
+    def _campaign_points(self, project: Project) -> Optional[Dict[str, int]]:
+        src = project.get(CHAOS)
+        if src is None or src.tree is None:
+            return None
+        for node in ast.walk(src.tree):
+            if (
+                isinstance(node, ast.Assign)
+                and any(
+                    isinstance(t, ast.Name) and t.id == "CAMPAIGN_POINTS"
+                    for t in node.targets
+                )
+                and hasattr(node.value, "elts")
+            ):
+                return {
+                    literal_str(v): v.lineno
+                    for v in node.value.elts
+                    if literal_str(v) is not None
+                }
+        return None
+
+    def _check_chaos_coverage(self, project: Project) -> Iterable[Finding]:
+        """KNOWN_POINTS <-> CAMPAIGN_POINTS parity. A fault point the
+        chaos campaign never drives is resilience behavior only unit
+        tests cover — the end-to-end no-failed-requests proof silently
+        stopped applying to it. Accepted gaps (points whose blast radius
+        a single-process campaign cannot stage) carry baseline
+        justifications, not silence. Findings anchor at the KNOWN_POINTS
+        entry so the fingerprint survives campaign-matrix reordering."""
+        known = self._known_points(project)
+        campaign = self._campaign_points(project)
+        if known is None or campaign is None:
+            return
+        for point, lineno in sorted(known.items()):
+            if point not in campaign:
+                yield Finding(
+                    rule=RULE_CHAOS_UNCOVERED,
+                    path=FAULTS,
+                    line=lineno,
+                    symbol="KNOWN_POINTS",
+                    message=(
+                        f"fault point `{point}` is not in the chaos "
+                        f"campaign matrix ({CHAOS} CAMPAIGN_POINTS) — "
+                        "no CI proof that live traffic survives it"
+                    ),
+                )
+        for point, lineno in sorted(campaign.items()):
+            if point not in known:
+                yield Finding(
+                    rule=RULE_CHAOS_UNKNOWN,
+                    path=CHAOS,
+                    line=lineno,
+                    symbol="CAMPAIGN_POINTS",
+                    message=(
+                        f"campaign point `{point}` is not declared in "
+                        "testing/faults.KNOWN_POINTS (stale matrix entry "
+                        "fires nothing)"
                     ),
                 )
 

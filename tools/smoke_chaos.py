@@ -13,8 +13,12 @@ probe raises), ``fleet.proxy`` (proxied owner GET fails),
 fails), ``fleet.member`` (membership marker read/write/confirm/list
 fails — heartbeats count failures and retry, serving never notices),
 ``warmstart.cache`` (manifest reads fail — the replica boots cold
-instead of warm) — × {NORMAL, BROWNOUT}, asserting the standing
-invariants every time:
+instead of warm) — × {NORMAL, BROWNOUT, ISLAND}, asserting the
+standing invariants every time (the ISLAND level runs every point with
+the shared-tier supervisor tripped into island mode — L2 ops
+short-circuit locally, docs/resilience.md "Shared-tier outage
+survival" — proving each fault's degrade path composes with a dead
+shared tier):
 
 - no hang past the deadline (every request wrapped in a wait bound),
 - correct 5xx/503 mapping (the faults degrade, they never surface as
@@ -80,7 +84,7 @@ CAMPAIGN_POINTS = (
     "device.backend", "fleet.proxy", "l2.lease", "l2.storage",
     "fleet.member", "warmstart.cache",
 )
-CAMPAIGN_LEVELS = ("normal", "brownout")
+CAMPAIGN_LEVELS = ("normal", "brownout", "island")
 
 
 def _counter_samples(text: str) -> dict:
@@ -131,7 +135,11 @@ async def _campaign_case(point: str, level: str) -> None:
 
     from flyimg_tpu.appconfig import AppParameters
     from flyimg_tpu.codecs import encode
-    from flyimg_tpu.service.app import SUPERVISOR_KEY, make_app
+    from flyimg_tpu.service.app import (
+        SUPERVISOR_KEY,
+        TIER_SUPERVISOR_KEY,
+        make_app,
+    )
     from flyimg_tpu.testing import faults
 
     tmp = tempfile.mkdtemp(prefix=f"flyimg-chaos-{point.replace('.', '-')}-")
@@ -150,6 +158,19 @@ async def _campaign_case(point: str, level: str) -> None:
         # + SWR active, no shedding) for every evaluation
         conf["brownout_enable"] = True
         injector.plan("brownout.signal", lambda **_: 0.9)
+    elif level == "island":
+        # the shared-tier supervisor runs and is tripped into island
+        # mode right after boot (below): every L2 op short-circuits
+        # locally and the point's fault must compose with that. The
+        # probe interval is parked high so the case stays islanded.
+        conf.update({
+            "l2_enable": True,
+            "l2_upload_dir": shared,
+            "tier_supervisor_enable": True,
+            "tier_storm_threshold": 2,
+            "tier_storm_window_s": 60.0,
+            "tier_probe_interval_s": 60.0,
+        })
     storm_statuses: set = set()
     if point == "device.backend":
         # a dying backend: the first request's launch AND its recovery
@@ -254,6 +275,17 @@ async def _campaign_case(point: str, level: str) -> None:
                 client.get(path), timeout=REQUEST_TIMEOUT_S
             )
 
+        tier_sup = None
+        if level == "island":
+            # trip the tier breaker through its documented outcome
+            # feed; everything below must serve from L1 alone
+            tier_sup = app[TIER_SUPERVISOR_KEY]
+            for _ in range(tier_sup.storm_threshold):
+                tier_sup.record_failure("campaign")
+            _require(
+                tier_sup.islanded(),
+                f"{label} tier breaker tripped into island mode",
+            )
         before = _counter_samples(
             await (await client.get("/metrics")).text()
         )
@@ -300,14 +332,17 @@ async def _campaign_case(point: str, level: str) -> None:
             )
         if point == "fleet.member":
             # the beats kept failing while we served: counted, never
-            # surfaced, and nothing half-written into the shared tier
+            # surfaced, and nothing half-written into the shared tier.
+            # (Islanded, the beats SKIP marker IO entirely — the skip
+            # assertion below covers that level instead.)
             text = await (await client.get("/metrics")).text()
-            _require(
-                _metric_value(
-                    text, "flyimg_fleet_heartbeat_failures_total"
-                ) >= 1.0,
-                f"{label} heartbeat failures counted",
-            )
+            if level != "island":
+                _require(
+                    _metric_value(
+                        text, "flyimg_fleet_heartbeat_failures_total"
+                    ) >= 1.0,
+                    f"{label} heartbeat failures counted",
+                )
             _require(
                 not glob.glob(os.path.join(shared, "**", "*.member"),
                               recursive=True),
@@ -322,6 +357,29 @@ async def _campaign_case(point: str, level: str) -> None:
                     'flyimg_warmstart_programs_total{outcome="seeded"}',
                 ) == 0.0,
                 f"{label} nothing seeded through the fault",
+            )
+        if tier_sup is not None:
+            # island mode held through the traffic: L2 ops were
+            # short-circuited (misses write L1-only and journal), the
+            # state is surfaced on /readyz, and the breaker never
+            # silently re-attached
+            _require(
+                tier_sup.islanded(),
+                f"{label} still islanded after traffic",
+            )
+            _require(
+                tier_sup.snapshot()["island_skips"] >= 1,
+                f"{label} island short-circuits counted",
+            )
+            import json as _json
+
+            ready = _json.loads(
+                await (await client.get("/readyz")).text()
+            )
+            _require(
+                ready.get("tier") == "island",
+                f"{label} /readyz reports tier island "
+                f"(got {ready.get('tier')!r})",
             )
         # standing invariants
         _require(

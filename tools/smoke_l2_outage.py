@@ -1,0 +1,464 @@
+"""CI shared-tier outage smoke: a 2-replica SUBPROCESS fleet survives a
+full L2 outage mid-traffic (docs/resilience.md "Shared-tier outage
+survival").
+
+Choreography — the driver process spawns two real replica processes
+over one shared local L2, then walks the whole outage lifecycle:
+
+1. **baseline**: cross-replica serving works (replica B gets an L2
+   promotion for a key replica A rendered), and a healthy-miss latency
+   p50 is measured.
+2. **outage mid-traffic**: a flag file flips every ``l2.storage`` /
+   ``l2.lease`` op in BOTH replicas to sleep-then-raise (a timing-out
+   dead tier) while live traffic keeps arriving. **Zero requests may
+   fail** — every pre-trip op degrades per-op, and within the storm
+   window both replicas' tier breakers trip into island mode
+   (``/debug/tier``). Post-trip misses must show NO per-request L2
+   timeout amplification: their p50 is bounded against the healthy
+   baseline (the short-circuit is the point — a dead tier costs
+   nothing per request once islanded).
+3. **island render**: replica A renders a brand-new key while
+   islanded — its artifact write and variant manifest land in the
+   write-behind journal, not the dead tier.
+4. **heal + replay**: the flag clears, consecutive clean probes
+   re-promote, and the journal replays FIRST — after which replica B
+   (which never saw the key) serves a derivative of the
+   island-rendered ancestor as a cross-replica reuse HIT: the island
+   window left no permanent hole in the shared tier.
+5. **scrub**: a torn artifact (garbage bytes behind a ``.png`` name)
+   seeded into the shared tier AND replica A's L1 is detected by A's
+   anti-entropy scrubber and purged from both tiers.
+
+Replica mode (``--replica``) is how the fault crosses the process
+boundary: the subprocess installs a flag-file-watching fault plan
+before booting the real serve entrypoint, so the driver flips the
+outage on and off by touching one file.
+
+    JAX_PLATFORMS=cpu python tools/smoke_l2_outage.py
+
+Exit code 0 = every assertion held. The behavioral matrix (storm math,
+journal bounds, replay edges, scrub verdicts) lives in
+tests/test_tier_supervisor.py; this script proves the assembled fleet
+survives the outage end to end."""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+#: per-op latency of the injected dead tier (sleep, then raise): the
+#: "timeout amplification" phase 2 proves island mode removes
+FAULT_DELAY_S = 0.4
+
+STORM_THRESHOLD = 3
+STORM_WINDOW_S = 30.0
+
+MISS_OPTS = "w_64,o_png"
+ANCESTOR_OPTS = "w_256,o_png"
+DERIVED_OPTS = "w_120,h_90,c_1,o_png"
+
+
+def _require(cond: bool, what: str) -> None:
+    if not cond:
+        print(f"FAIL: {what}", file=sys.stderr)
+        raise SystemExit(1)
+
+
+def _metric_value(text: str, name: str) -> float:
+    for line in text.splitlines():
+        if line.startswith(name + " "):
+            try:
+                return float(line.rsplit(" ", 1)[1])
+            except ValueError:
+                continue
+    return 0.0
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _median(values):
+    ordered = sorted(values)
+    return ordered[len(ordered) // 2]
+
+
+# ---------------------------------------------------------------------------
+# replica mode: install the flag-file outage plan, then serve for real
+
+
+def _replica_main(args) -> int:
+    from flyimg_tpu.testing import faults
+
+    flag = args.flagfile
+
+    def outage_plan(**_ctx):
+        if os.path.exists(flag):
+            time.sleep(FAULT_DELAY_S)
+            raise OSError("injected shared-tier outage")
+        return faults.PASS
+
+    injector = faults.FaultInjector()
+    injector.plan("l2.storage", outage_plan)
+    injector.plan("l2.lease", outage_plan)
+    faults.install(injector)
+
+    from flyimg_tpu.service import app as app_mod
+
+    return app_mod.main([
+        "serve", "--host", "127.0.0.1", "--port", str(args.port),
+        "--params", args.params,
+    ])
+
+
+# ---------------------------------------------------------------------------
+# driver
+
+
+def _spawn(tmp: str, name: str, port: int, shared: str, flag: str, *,
+           scrub: bool):
+    root = os.path.join(tmp, name)
+    os.makedirs(root, exist_ok=True)
+    params_path = os.path.join(root, "params.yml")
+    with open(params_path, "w") as fh:
+        fh.write("debug: true\n")
+        fh.write(f"upload_dir: {os.path.join(root, 'out')}\n")
+        fh.write(f"tmp_dir: {os.path.join(root, 'tmp')}\n")
+        fh.write("batch_deadline_ms: 2.0\n")
+        fh.write("reuse_enable: true\n")
+        fh.write("l2_enable: true\n")
+        fh.write(f"l2_upload_dir: {shared}\n")
+        fh.write("l2_checksum_enable: true\n")
+        fh.write(f"fleet_replica_id: http://127.0.0.1:{port}\n")
+        fh.write("tier_supervisor_enable: true\n")
+        fh.write(f"tier_storm_threshold: {STORM_THRESHOLD}\n")
+        fh.write(f"tier_storm_window_s: {STORM_WINDOW_S}\n")
+        fh.write("tier_probe_interval_s: 0.5\n")
+        fh.write("tier_probe_hysteresis: 2\n")
+        if scrub:
+            fh.write("tier_scrub_enable: true\n")
+            fh.write("tier_scrub_interval_s: 1.0\n")
+            fh.write("tier_scrub_sample: 64\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO_ROOT)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--replica",
+         "--port", str(port), "--params", params_path,
+         "--flagfile", flag],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env,
+    )
+    return proc, f"http://127.0.0.1:{port}", os.path.join(root, "out")
+
+
+async def _wait_healthy(client, url: str, timeout_s: float = 180.0) -> None:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            async with client.get(f"{url}/healthz") as r:
+                if r.status == 200:
+                    return
+        except Exception:
+            pass
+        await asyncio.sleep(0.5)
+    _require(False, f"{url} never became healthy")
+
+
+async def _tier_state(client, url: str) -> str:
+    try:
+        async with client.get(f"{url}/debug/tier") as r:
+            return str((await r.json()).get("state", ""))
+    except Exception:
+        return ""
+
+
+async def _wait_tier_state(client, url: str, want: str,
+                           timeout_s: float) -> float:
+    start = time.monotonic()
+    deadline = start + timeout_s
+    while time.monotonic() < deadline:
+        if await _tier_state(client, url) == want:
+            return time.monotonic() - start
+        await asyncio.sleep(0.1)
+    _require(False, f"{url} never reached tier state {want!r} "
+                    f"(last: {await _tier_state(client, url)!r})")
+    return 0.0
+
+
+async def _metric(client, url: str, name: str) -> float:
+    async with client.get(f"{url}/metrics") as r:
+        return _metric_value(await r.text(), name)
+
+
+async def _timed_get(client, url: str, path: str):
+    start = time.monotonic()
+    async with client.get(f"{url}{path}") as r:
+        await r.read()
+        return r.status, time.monotonic() - start
+
+
+async def _drive(client, urls, requests) -> int:
+    """Serially fire ``requests`` (url-index, path) pairs; returns the
+    non-200 count."""
+    failed = 0
+    for which, path in requests:
+        try:
+            status, _ = await _timed_get(client, urls[which], path)
+            if status != 200:
+                failed += 1
+        except Exception:
+            failed += 1
+    return failed
+
+
+async def _main_async() -> int:
+    import aiohttp
+    import numpy as np
+
+    from flyimg_tpu.codecs import encode
+
+    tmp = tempfile.mkdtemp(prefix="flyimg-l2-outage-")
+    shared = os.path.join(tmp, "shared-l2")
+    os.makedirs(shared, exist_ok=True)
+    flag = os.path.join(tmp, "l2-outage.flag")
+
+    yy, xx = np.mgrid[0:300, 0:400].astype(np.float32)
+    base = np.stack(
+        [xx * (255.0 / 399.0), yy * (255.0 / 299.0),
+         (xx + yy) * (255.0 / 698.0)],
+        axis=-1,
+    ).astype(np.uint8)
+
+    def _src(name: str, seed: int) -> str:
+        rng = np.random.default_rng(seed)
+        jitter = rng.integers(0, 25, base.shape, dtype=np.uint8)
+        path = os.path.join(tmp, f"{name}.png")
+        with open(path, "wb") as fh:
+            fh.write(encode((base // 2 + jitter), "png"))
+        return path
+
+    src_hot = _src("hot", 1)
+    src_island = _src("island", 2)
+    # one fresh source per measured miss: same options string = same
+    # compiled program, distinct cache key — latencies stay comparable
+    miss_srcs = [_src(f"miss-{i}", 10 + i) for i in range(14)]
+
+    procs = {}
+    timeout = aiohttp.ClientTimeout(total=180)
+    async with aiohttp.ClientSession(timeout=timeout) as client:
+        try:
+            pa, pb = _free_port(), _free_port()
+            procs["a"], url_a, l1_a = _spawn(
+                tmp, "a", pa, shared, flag, scrub=True,
+            )
+            procs["b"], url_b, _l1_b = _spawn(
+                tmp, "b", pb, shared, flag, scrub=False,
+            )
+            await _wait_healthy(client, url_a)
+            await _wait_healthy(client, url_b)
+            urls = (url_a, url_b)
+
+            print("== phase 1: healthy baseline (cross-replica + p50)")
+            status, _ = await _timed_get(
+                client, url_a, f"/upload/{ANCESTOR_OPTS}/{src_hot}"
+            )
+            _require(status == 200, f"A ancestor render 200 ({status})")
+            status, _ = await _timed_get(
+                client, url_b, f"/upload/{ANCESTOR_OPTS}/{src_hot}"
+            )
+            _require(status == 200, f"B shared-tier hit 200 ({status})")
+            _require(
+                await _metric(
+                    client, url_b, "flyimg_l2_promotions_total"
+                ) >= 1.0,
+                "B promoted A's render out of the shared tier",
+            )
+            # warm the miss program, then measure the healthy p50
+            status, _ = await _timed_get(
+                client, url_a, f"/upload/{MISS_OPTS}/{miss_srcs[0]}"
+            )
+            _require(status == 200, "warm-up miss 200")
+            healthy = []
+            for src in miss_srcs[1:5]:
+                status, took = await _timed_get(
+                    client, url_a, f"/upload/{MISS_OPTS}/{src}"
+                )
+                _require(status == 200, "baseline miss 200")
+                healthy.append(took)
+            pre_p50 = _median(healthy)
+            print(f"   ok: healthy miss p50 {pre_p50 * 1000:.0f} ms")
+
+            print("== phase 2: full L2 outage mid-traffic")
+            # live traffic: hits + fresh misses on both replicas; the
+            # flag flips mid-stream. NOTHING may fail.
+            live = [
+                (0, f"/upload/{ANCESTOR_OPTS}/{src_hot}"),
+                (1, f"/upload/{ANCESTOR_OPTS}/{src_hot}"),
+            ]
+            failed = await _drive(client, urls, live)
+            with open(flag, "w") as fh:
+                fh.write("outage\n")
+            t_flag = time.monotonic()
+            # the storm: misses on BOTH replicas pay per-op degrades
+            # (fetch + lease + write-through all fail) until each
+            # replica's breaker trips
+            storm = [
+                (0, f"/upload/{MISS_OPTS}/{miss_srcs[5]}"),
+                (1, f"/upload/{MISS_OPTS}/{miss_srcs[6]}"),
+                (0, f"/upload/{ANCESTOR_OPTS}/{src_hot}"),
+                (1, f"/upload/{ANCESTOR_OPTS}/{src_hot}"),
+                (0, f"/upload/{MISS_OPTS}/{miss_srcs[7]}"),
+                (1, f"/upload/{MISS_OPTS}/{miss_srcs[8]}"),
+            ]
+            failed += await _drive(client, urls, storm)
+            _require(
+                failed == 0,
+                f"zero failed requests through the outage flip "
+                f"(saw {failed})",
+            )
+            trip_a = await _wait_tier_state(
+                client, url_a, "island", STORM_WINDOW_S
+            )
+            trip_b = await _wait_tier_state(
+                client, url_b, "island", STORM_WINDOW_S
+            )
+            del trip_a, trip_b
+            _require(
+                time.monotonic() - t_flag <= STORM_WINDOW_S,
+                "both breakers tripped within the storm window",
+            )
+            print(f"   ok: both replicas islanded "
+                  f"({time.monotonic() - t_flag:.1f}s after the flip)")
+            # post-trip misses: the dead tier costs NOTHING per
+            # request anymore — no per-op timeout amplification
+            islanded = []
+            for src in miss_srcs[9:13]:
+                status, took = await _timed_get(
+                    client, url_a, f"/upload/{MISS_OPTS}/{src}"
+                )
+                _require(status == 200, "islanded miss 200")
+                islanded.append(took)
+            post_p50 = _median(islanded)
+            _require(
+                post_p50 <= pre_p50 * 2.0 + FAULT_DELAY_S,
+                f"islanded miss p50 bounded (healthy "
+                f"{pre_p50 * 1000:.0f} ms -> islanded "
+                f"{post_p50 * 1000:.0f} ms, injected per-op delay "
+                f"{FAULT_DELAY_S * 1000:.0f} ms)",
+            )
+            print(f"   ok: islanded miss p50 {post_p50 * 1000:.0f} ms "
+                  f"(no L2 timeouts paid)")
+
+            print("== phase 3: island render, heal, journal replay")
+            status, _ = await _timed_get(
+                client, url_a, f"/upload/{ANCESTOR_OPTS}/{src_island}"
+            )
+            _require(status == 200, "island-window render 200")
+            os.remove(flag)
+            await _wait_tier_state(client, url_a, "attached", 30.0)
+            await _wait_tier_state(client, url_b, "attached", 30.0)
+            replayed = await _metric(
+                client, url_a,
+                'flyimg_tier_journal_replayed_total{kind="artifact"}',
+            )
+            _require(
+                replayed >= 1.0,
+                f"journal replayed island artifacts (saw {replayed})",
+            )
+            _require(
+                await _metric(
+                    client, url_a,
+                    'flyimg_tier_journal_replayed_total{kind="manifest"}',
+                ) >= 1.0,
+                "journal replayed the island variant manifest",
+            )
+            # the island window left no hole: replica B (which never
+            # saw the key) serves a derivative of the island-rendered
+            # ancestor as a cross-replica reuse hit
+            hits_before = await _metric(
+                client, url_b, 'flyimg_reuse_hits_total{outcome="hit"}'
+            )
+            status, _ = await _timed_get(
+                client, url_b, f"/upload/{DERIVED_OPTS}/{src_island}"
+            )
+            _require(status == 200, "post-heal derivative 200")
+            hits_after = await _metric(
+                client, url_b, 'flyimg_reuse_hits_total{outcome="hit"}'
+            )
+            _require(
+                hits_after >= hits_before + 1.0,
+                f"replayed ancestor served B's reuse hit "
+                f"({hits_before} -> {hits_after})",
+            )
+            print("   ok: re-attached, journal replayed, "
+                  "cross-replica ancestor hit restored")
+
+            print("== phase 4: anti-entropy scrub purges a torn artifact")
+            torn = "feedfacefeedfacefeedfacefeedface.png"
+            garbage = b"\x00\x01 not a png at all \x02\x03" * 8
+            with open(os.path.join(shared, torn), "wb") as fh:
+                fh.write(garbage)
+            with open(os.path.join(l1_a, torn), "wb") as fh:
+                fh.write(garbage)
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                if not os.path.exists(os.path.join(shared, torn)) and \
+                        not os.path.exists(os.path.join(l1_a, torn)):
+                    break
+                await asyncio.sleep(0.5)
+            _require(
+                not os.path.exists(os.path.join(shared, torn)),
+                "scrubber purged the torn artifact from the shared tier",
+            )
+            _require(
+                not os.path.exists(os.path.join(l1_a, torn)),
+                "scrubber purged the torn artifact from the L1 too",
+            )
+            _require(
+                await _metric(
+                    client, url_a,
+                    'flyimg_tier_scrubbed_total{outcome="purged-magic"}',
+                ) >= 1.0,
+                "scrub purge counted",
+            )
+            print("   ok: torn artifact purged from both tiers")
+        finally:
+            for proc in procs.values():
+                if proc.poll() is None:
+                    proc.send_signal(signal.SIGTERM)
+            for proc in procs.values():
+                try:
+                    proc.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+
+    print("l2-outage smoke OK: zero failures through a full shared-tier "
+          "outage, island p50 bounded, journal replayed, scrub clean")
+    return 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(prog="smoke_l2_outage")
+    parser.add_argument("--replica", action="store_true")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--params", default=None)
+    parser.add_argument("--flagfile", default=None)
+    args = parser.parse_args()
+    if args.replica:
+        return _replica_main(args)
+    return asyncio.run(_main_async())
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
