@@ -287,9 +287,46 @@ def _append_history(line: str) -> None:
         pass
 
 
+def _telemetry_stamp(line: str) -> str:
+    """Traffic-shape attribution (ISSUE 19 satellite): when
+    FLYIMG_BENCH_TELEMETRY_URL names a running app's base URL, scrape
+    its debug-gated /debug/telemetry once and stamp the observed mix
+    label + archive segment count into the final JSON record, so
+    BENCH_r06+ artifacts carry which traffic shape produced the number.
+    Best-effort everywhere: no URL, a dead server, a 404 (debug off),
+    or a non-JSON body all leave the line untouched — attribution must
+    never fail a bench that already produced its number."""
+    base = os.environ.get("FLYIMG_BENCH_TELEMETRY_URL", "").strip()
+    if not base:
+        return line
+    try:
+        record = json.loads(line)
+        if not isinstance(record, dict):
+            return line
+    except ValueError:
+        return line
+    try:
+        import urllib.request
+
+        with urllib.request.urlopen(
+            base.rstrip("/") + "/debug/telemetry", timeout=5
+        ) as resp:
+            doc = json.loads(resp.read().decode("utf-8"))
+        if isinstance(doc, dict) and doc.get("enabled"):
+            record["traffic_mix"] = (doc.get("mix") or {}).get("label")
+            record["telemetry_segments"] = len(
+                (doc.get("archive") or {}).get("segments") or []
+            )
+            return json.dumps(record)
+    except Exception:
+        pass
+    return line
+
+
 def _emit_final(line: str) -> None:
     """THE single exit point for the supervisor's one promised JSON line:
     print it AND append it to the history trajectory."""
+    line = _telemetry_stamp(line)
     print(line)
     _append_history(line)
 

@@ -243,6 +243,35 @@ SERVER_DEFAULTS: Dict[str, Any] = {
     "flightrecorder_dump_dir": "",
     "flightrecorder_min_dump_interval_s": 30.0,
     "flightrecorder_max_dumps": 16,
+    # --- telemetry warehouse + traffic-mix classifier
+    # (runtime/telemetry.py; docs/observability.md "Telemetry warehouse
+    # & traffic-mix classifier"). Default-off: with telemetry_enable
+    # unset there is no directory, no metrics family, and the serving
+    # path is byte-identical (pinned by tests/test_telemetry.py).
+    "telemetry_enable": False,
+    # archive directory ('' -> <tmp_dir>/telemetry)
+    "telemetry_dir": "",
+    # seconds between snapshot beats (the beat rides the request
+    # middleware like brownout.evaluate(); never a timer thread)
+    "telemetry_snapshot_interval_s": 10.0,
+    # segment rotation: a segment closes when it reaches this many
+    # bytes OR this many seconds old, whichever comes first
+    "telemetry_segment_max_bytes": 1048576,
+    "telemetry_segment_max_age_s": 300.0,
+    # total retention: closed segments evict oldest-first past either
+    # bound (the writable segment never evicts)
+    "telemetry_retention_max_bytes": 33554432,
+    "telemetry_retention_max_segments": 64,
+    # flight-recorder dump files join the same retention family: >0
+    # overrides the legacy flightrecorder_max_dumps bound (which stays
+    # as the documented alias when this is 0)
+    "telemetry_retention_max_dumps": 0,
+    # traffic-mix classifier: fingerprint window (requests), minimum
+    # samples before a label is proposed, and consecutive agreeing
+    # beats required before the adopted label flips
+    "telemetry_mix_window": 256,
+    "telemetry_mix_min_samples": 8,
+    "telemetry_mix_hysteresis": 2,
     # --- perf-regression gate defaults (tools/perf_gate.py; CLI flags
     # override; benchmarks/README.md "baseline refresh policy") ---
     # a stage regresses when its calibrated median exceeds
@@ -529,6 +558,11 @@ SERVER_DEFAULTS: Dict[str, Any] = {
     # / probe / journal-TTL bookkeeping (runtime/tiersupervisor.py
     # from_params) — same hook style as device_supervisor_clock
     "tier_supervisor_clock": None,
+    # injectable WALL clock for telemetry archive timestamps and the
+    # snapshot beat (runtime/telemetry.py from_params) — wall, not
+    # monotonic: archive records are compared across restarts, the
+    # same reasoning as fleet_membership_clock
+    "telemetry_clock": None,
 }
 
 

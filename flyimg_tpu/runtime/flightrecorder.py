@@ -265,6 +265,21 @@ class FlightRecorder:
             ),
         }
 
+    # -- artifact retention surface (runtime/telemetry.py) -----------------
+
+    def dump_files(self) -> List[str]:
+        """Dump-file names, oldest first — the telemetry archive indexes
+        these in its artifact inventory (docs/observability.md
+        "Telemetry warehouse & traffic-mix classifier")."""
+        return [name for name, _ in self._dump_files()]
+
+    def prune_dumps(self) -> None:
+        """Re-apply the dump retention bound now. The telemetry pipeline
+        calls this after overriding ``max_dumps`` with the unified
+        ``telemetry_retention_max_dumps`` knob so a tightened bound
+        takes effect without waiting for the next incident dump."""
+        self._prune_dumps()
+
     # -- read surface ------------------------------------------------------
 
     def snapshot(self, limit: int = 128) -> Dict[str, object]:

@@ -93,6 +93,9 @@ OBSERVATORY_KEY: web.AppKey = web.AppKey("observatory", object)
 # shared-tier outage supervisor (runtime/tiersupervisor.py): tests and
 # the L2-outage smoke reach the island/journal state machine here
 TIER_SUPERVISOR_KEY: web.AppKey = web.AppKey("tier_supervisor", object)
+# telemetry warehouse + traffic-mix classifier (runtime/telemetry.py):
+# tests and the telemetry smoke reach the archive/classifier here
+TELEMETRY_KEY: web.AppKey = web.AppKey("telemetry", object)
 
 # routes that run the image pipeline get a trace; infrastructure routes
 # (/metrics scrapes, health probes) would only fill the ring with noise
@@ -396,11 +399,25 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
             "Pending (queued or executing) tasks per host stage pool",
             fn=lambda p=stage_pool: float(p.pending),
         )
+    # telemetry warehouse + traffic-mix classifier (runtime/telemetry.py;
+    # docs/observability.md "Telemetry warehouse & traffic-mix
+    # classifier"): durable JSONL archive of the signal vocabulary plus
+    # the nearest-centroid traffic-shape label. Constructed before the
+    # handler (which records per-request mix features into it); the
+    # signal surfaces attach after the observatory below. Inert (no
+    # directory, no metrics, handler holds None) with telemetry_enable
+    # off — byte-identical serving pinned by tests/test_telemetry.py.
+    from flyimg_tpu.runtime.telemetry import TelemetryPipeline
+
+    telemetry = TelemetryPipeline.from_params(
+        params, metrics=metrics, replica_id=replica_id
+    )
     handler = ImageHandler(
         storage, params, batcher=batcher, codec_batcher=codec_batcher,
         face_backend=face_backend, metrics=metrics, sp_mesh=sp_mesh,
         brownout=brownout, host_pipeline=host_pipeline,
         device_supervisor=supervisor if supervisor.enabled else None,
+        telemetry=telemetry if telemetry.enabled else None,
     )
     # shared-tier outage supervisor (runtime/tiersupervisor.py;
     # docs/resilience.md "Island mode"): watches L2 storage / lease /
@@ -600,6 +617,28 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
         # the digest/rollup/recommendation beat rides the membership
         # heartbeat, the same piggyback slot as the warm-start publish
         membership.observatory = observatory
+    if telemetry.enabled:
+        # the warehouse owns its OWN SignalWindow (launches_delta diffs
+        # per instance — sharing the observatory's would corrupt both)
+        telemetry.attach(
+            metrics=metrics,
+            slo=slo,
+            brownout=brownout,
+            host_pipeline=host_pipeline,
+            flight_recorder=flight_recorder,
+            reuse_fn=(
+                reuse_signal_fn(metrics)
+                if handler.reuse_enable else None
+            ),
+            ledger_fn=cost_ledger.aggregates,
+        )
+        # satellite retention unification: dump files join the archive's
+        # retention family (telemetry_retention_max_dumps > 0 overrides
+        # the legacy flightrecorder_max_dumps bound, kept as the alias)
+        telemetry.adopt_dump_retention(
+            flight_recorder,
+            int(params.by_key("telemetry_retention_max_dumps", 0)),
+        )
 
     @web.middleware
     async def observability(request: web.Request, handler):
@@ -640,6 +679,10 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
                 supervisor.evaluate()
                 # tier island/repromote events drain the same way
                 tier_supervisor.evaluate()
+                # the telemetry snapshot beat rides the same hook
+                # (rate-limited inside it; one bool check when off) so
+                # window records and mix flips cost no timer thread
+                telemetry.evaluate()
             if trace is not None:
                 trace.root.set_attribute("route", route)
                 trace.root.set_attribute("http.method", request.method)
@@ -736,6 +779,7 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
     app[MEMBERSHIP_KEY] = membership
     app[OBSERVATORY_KEY] = observatory
     app[TIER_SUPERVISOR_KEY] = tier_supervisor
+    app[TELEMETRY_KEY] = telemetry
 
     # readiness vs liveness: /healthz answers "is the process + device
     # runtime up", /readyz answers "should a load balancer route here".
@@ -777,6 +821,8 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
         # after the marker release attempt: an islanded close skips the
         # marker IO above, and the prober/scrubber threads stop here
         tier_supervisor.close()
+        # final telemetry beat (the shutdown window) + segment release
+        telemetry.close()
         if injector is not None:
             from flyimg_tpu.testing import faults
 
@@ -1232,6 +1278,22 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
             content_type="application/json",
         )
 
+    async def debug_telemetry(_request: web.Request) -> web.Response:
+        """Telemetry warehouse: classifier state (adopted/raw label,
+        features, transitions) + the archive inventory + the unified
+        artifact index (runtime/telemetry.py snapshot;
+        docs/observability.md "Telemetry warehouse & traffic-mix
+        classifier")."""
+        import json as _json
+
+        denied = _debug_gate_404()
+        if denied is not None:
+            return denied
+        return web.Response(
+            text=_json.dumps(telemetry.snapshot()),
+            content_type="application/json",
+        )
+
     async def debug_profile_get(_request: web.Request) -> web.Response:
         """On-demand profiler state + completed captures
         (runtime/profiling.py; docs/observability.md "On-demand device
@@ -1499,6 +1561,7 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
     app.router.add_get("/debug/perf", debug_perf)
     app.router.add_get("/debug/plans", debug_plans)
     app.router.add_get("/debug/flightrecorder", debug_flightrecorder)
+    app.router.add_get("/debug/telemetry", debug_telemetry)
     app.router.add_get("/debug/profile", debug_profile_get)
     app.router.add_post("/debug/profile", debug_profile_arm)
     app.router.add_get(

@@ -147,9 +147,15 @@ class ImageHandler:
         brownout=None,
         host_pipeline=None,
         device_supervisor=None,
+        telemetry=None,
     ) -> None:
         self.storage = storage
         self.params = params
+        # telemetry warehouse (runtime/telemetry.py): per-request mix
+        # feature recording at the outcome points below. None when
+        # telemetry_enable is off — every call site is one `is None`
+        # check, keeping the off path byte-identical.
+        self.telemetry = telemetry
         self.security = SecurityHandler(params)
         self.batcher = batcher  # BatchController; None = direct device calls
         # separate controller (own executor thread) for HOST codec work:
@@ -297,6 +303,21 @@ class ImageHandler:
             )
         return self._face_backend
 
+    def _record_mix(self, options, image_src: str,
+                    source_key, outcome: str) -> None:
+        """One traffic-mix observation into the telemetry classifier
+        (runtime/telemetry.py). Rides every outcome point INCLUDING
+        cache hits, so the body is one None check + one deque append;
+        with telemetry off the call site is a single `is None` check.
+        Computes its own source hash when reuse is off (source_key is
+        only populated on the reuse path)."""
+        if self.telemetry is None:
+            return
+        key = source_key or OptionsBag.hash_original_image_url(image_src)
+        self.telemetry.record_request(
+            options=options, source_key=key, outcome=outcome
+        )
+
     def process_image(
         self,
         options_str: str,
@@ -430,6 +451,10 @@ class ImageHandler:
             if self.metrics is not None:
                 self.metrics.record_cache(hit=True)
                 self.metrics.record_stage("cache_hit", time.perf_counter() - t0)
+            self._record_mix(
+                options, image_src, source_key,
+                "stale" if stale else "hit",
+            )
             return ProcessedImage(
                 content=content,
                 spec=spec,
@@ -446,6 +471,7 @@ class ImageHandler:
         if engine is not None and engine.shed_active():
             engine.record_degraded("shed")
             tracing.add_event("brownout.shed", key=spec.name)
+            self._record_mix(options, image_src, source_key, "shed")
             exc = ServiceUnavailableException(
                 "shedding cache-miss work under overload (brownout level "
                 "shed); cached outputs still serve"
@@ -487,6 +513,7 @@ class ImageHandler:
                     "flyimg_requests_coalesced_total",
                     "Cache-miss requests served by an in-flight duplicate",
                 ).inc()
+            self._record_mix(options, image_src, source_key, "coalesced")
             return ProcessedImage(
                 content=content, spec=spec, options=options, timings=timings,
                 modified_at=modified_at, degraded=degraded,
@@ -517,6 +544,9 @@ class ImageHandler:
                         self.metrics.record_stage(
                             "l2_coalesced", timings["l2_coalesced"]
                         )
+                    self._record_mix(
+                        options, image_src, source_key, "coalesced"
+                    )
                     return ProcessedImage(
                         content=remote_content, spec=spec, options=options,
                         from_cache=True, timings=timings,
@@ -633,6 +663,11 @@ class ImageHandler:
             self.metrics.record_cache(hit=False)
             for stage, seconds in timings.items():
                 self.metrics.record_stage(stage, seconds)
+        self._record_mix(
+            options, image_src, source_key,
+            "degraded" if modes
+            else "reuse" if reused is not None else "miss",
+        )
         return ProcessedImage(
             content=content, spec=spec, options=options, timings=timings,
             modified_at=modified_at, degraded=tuple(modes),

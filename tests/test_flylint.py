@@ -352,6 +352,78 @@ def test_registry_clean_fixture_passes(tmp_path):
     assert not result.findings
 
 
+def _telemetry_fixture(root, doc_text):
+    _write(root, "flyimg_tpu/runtime/telemetry.py", """\
+        RECORD_SCHEMAS = {
+            "boot": ("schema", "kind", "undocumented_field"),
+            "window": ("schema", "mix"),
+        }
+        """)
+    _write(root, "docs/observability.md", doc_text)
+
+
+def test_telemetry_schema_parity_trips_both_ways(tmp_path):
+    _telemetry_fixture(tmp_path, """\
+        ### Archive record schema
+
+        | Kind | Fields | Meaning |
+        |------|--------|---------|
+        | `boot` | `schema`, `kind` | envelope |
+        | `window` | `schema`, `mix` | the mix stamp |
+        | `window` | `ghost_field` | documented but never emitted |
+
+        ### Next section
+
+        | `boot` | `outside_section` | rows past the heading are ignored |
+        """)
+    result = _scan(tmp_path, checkers=[RegistryChecker()])
+    by_rule = {}
+    for f in result.findings:
+        by_rule.setdefault(f.rule, []).append(f)
+    assert set(by_rule) == {
+        "telemetry-field-undocumented", "telemetry-doc-unknown",
+    }
+    # code -> doc: the undocumented field, anchored at its schema entry
+    undoc = by_rule["telemetry-field-undocumented"]
+    assert len(undoc) == 1
+    assert "boot.undocumented_field" in undoc[0].message
+    assert undoc[0].path == "flyimg_tpu/runtime/telemetry.py"
+    # doc -> code: the ghost row, anchored at the doc line; the row
+    # outside the section is NOT parsed (no `boot.outside_section`)
+    ghost = by_rule["telemetry-doc-unknown"]
+    assert len(ghost) == 1
+    assert "window.ghost_field" in ghost[0].message
+    assert ghost[0].path == "docs/observability.md"
+
+
+def test_telemetry_schema_parity_clean_fixture_passes(tmp_path):
+    _telemetry_fixture(tmp_path, """\
+        ### Archive record schema
+
+        | Kind | Fields | Meaning |
+        |------|--------|---------|
+        | `boot` | `schema`, `kind`, `undocumented_field` | envelope |
+        | `window` | `schema`, `mix` | the mix stamp |
+        """)
+    result = _scan(tmp_path, checkers=[RegistryChecker()])
+    assert not result.findings
+
+
+def test_telemetry_parity_inert_without_module(tmp_path):
+    # the rule family must stay silent on projects without
+    # runtime/telemetry.py (every other registry fixture run)
+    _write(tmp_path, "flyimg_tpu/other.py", """\
+        X = 1
+        """)
+    _write(tmp_path, "docs/observability.md", """\
+        ### Archive record schema
+
+        | `boot` | `schema` | no telemetry module in this project |
+        """)
+    result = _scan(tmp_path, checkers=[RegistryChecker()])
+    assert not result.findings
+
+
 def _chaos_fixture(root, campaign, *, suppress=""):
     _write(root, "flyimg_tpu/testing/faults.py", f"""\
         KNOWN_POINTS = frozenset({{

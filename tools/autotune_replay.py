@@ -9,6 +9,7 @@ adjustments a live process would have made on that traffic:
 
     python -m tools.autotune_replay                       # bench history
     python -m tools.autotune_replay --flightrecorder dump.json
+    python -m tools.autotune_replay --telemetry var/tmp/telemetry
     python -m tools.autotune_replay --out-dir /tmp/autotune
 
 Inputs:
@@ -22,6 +23,12 @@ Inputs:
 - a flight-recorder dump (``--flightrecorder``): per-launch records are
   re-aggregated into rolling per-controller windows with the same math
   as ``BatchEfficiency.stats``, then replayed window by window.
+- a telemetry archive (``--telemetry``, a segment directory or a
+  ``telemetry_query export`` JSONL file; runtime/telemetry.py): window
+  records embed the live SignalWindow's ``controllers``/``host``/
+  ``kernel_mode`` verbatim, so they replay with full fidelity — the
+  ROADMAP item-4 planner input. Archives with only launch records fall
+  back to the flight-recorder re-aggregation math.
 
 Outputs (``--out-dir``, default ``var/tmp/autotune`` — never a tracked
 file):
@@ -128,6 +135,11 @@ def _flight_windows(path: str, window: int = 64) -> List[Dict]:
         r for r in doc.get("records", [])
         if isinstance(r, dict) and r.get("kind") != "host_stage"
     ]
+    return _aggregate_launch_windows(records, window=window)
+
+
+def _aggregate_launch_windows(records: List[Dict],
+                              window: int = 64) -> List[Dict]:
     windows: List[Dict] = []
     for start in range(0, len(records), max(window, 1)):
         chunk = records[start:start + window]
@@ -165,6 +177,60 @@ def _flight_windows(path: str, window: int = 64) -> List[Dict]:
             "kernel_mode": "dense",
         })
     return windows
+
+
+def _telemetry_windows(path: str) -> List[Dict]:
+    """Signal windows from a telemetry archive (runtime/telemetry.py):
+    ``path`` is a segment directory or an exported JSONL file
+    (``tools/telemetry_query.py export``). Archive WINDOW records embed
+    the live SignalWindow assembly verbatim and replay with full
+    fidelity (mix label carried through to the audit trail); an archive
+    holding only LAUNCH records re-aggregates them with the
+    flight-recorder math above."""
+    from flyimg_tpu.runtime.telemetry import read_archive
+
+    if os.path.isdir(path):
+        records = read_archive(path)["records"]
+    else:
+        records = []
+        with open(path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    records.append(rec)
+    windows: List[Dict] = []
+    for rec in records:
+        if rec.get("kind") != "window":
+            continue
+        controllers = rec.get("controllers")
+        signals: Dict = {
+            "controllers": controllers if isinstance(controllers, dict)
+            else {},
+            "host": rec.get("host") if isinstance(rec.get("host"), dict)
+            else {},
+            "kernel_mode": str(rec.get("kernel_mode") or "dense"),
+            "burn_fast_norm": rec.get("burn_fast_norm"),
+            "burn_slow_norm": rec.get("burn_slow_norm"),
+            "_row": {
+                "metric": f"telemetry_window:{rec.get('mix') or 'mixed'}",
+                "value": None,
+                "ts": rec.get("at_s"),
+            },
+        }
+        windows.append(signals)
+    if windows:
+        return windows
+    launches = [
+        r for r in records
+        if r.get("kind") == "launch" and r.get("launch_kind") != "host_stage"
+    ]
+    return _aggregate_launch_windows(launches)
 
 
 def replay(windows: List[Dict],
@@ -226,6 +292,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="replay a flight-recorder dump instead of the bench history",
     )
     parser.add_argument(
+        "--telemetry", default=None,
+        help="replay a telemetry archive (segment directory or exported "
+             "JSONL) instead of the bench history",
+    )
+    parser.add_argument(
         "--baseline",
         default=os.path.join(REPO_ROOT, "benchmarks", "perf_baseline.json"),
     )
@@ -235,7 +306,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     args = parser.parse_args(argv)
 
-    if args.flightrecorder:
+    if args.telemetry:
+        windows = _telemetry_windows(args.telemetry)
+        source = args.telemetry
+    elif args.flightrecorder:
         windows = _flight_windows(args.flightrecorder)
         source = args.flightrecorder
     else:

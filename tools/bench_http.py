@@ -1009,6 +1009,18 @@ async def _scrape_observability(client: httpx.AsyncClient, base: str):
     perf = await _get("/debug/perf")
     plans = await _get("/debug/plans")
     recorder = await _get("/debug/flightrecorder")
+    # telemetry warehouse (runtime/telemetry.py): the adopted traffic-mix
+    # label + archive segment count, compact — None when the endpoint
+    # 404s (debug off) or the warehouse is disabled
+    telemetry_doc = await _get("/debug/telemetry")
+    telemetry = None
+    if isinstance(telemetry_doc, dict) and telemetry_doc.get("enabled"):
+        telemetry = {
+            "mix": (telemetry_doc.get("mix") or {}).get("label"),
+            "segments": len(
+                (telemetry_doc.get("archive") or {}).get("segments") or []
+            ),
+        }
     plan_costs = None
     if plans is not None:
         rows = plans.get("plans", [])
@@ -1038,6 +1050,7 @@ async def _scrape_observability(client: httpx.AsyncClient, base: str):
         "flightrecorder": (
             recorder.get("summary") if recorder is not None else None
         ),
+        "telemetry": telemetry,
     }
 
 
@@ -1588,6 +1601,13 @@ async def main() -> int:
                     row["batch_efficiency"] = obs["batch_efficiency"]
                     row["plan_costs"] = obs["plan_costs"]
                     row["flightrecorder"] = obs["flightrecorder"]
+                    if obs.get("telemetry") is not None:
+                        # traffic-shape attribution (ISSUE 19): which
+                        # mix label the warehouse adopted for this run
+                        row["traffic_mix"] = obs["telemetry"]["mix"]
+                        row["telemetry_segments"] = (
+                            obs["telemetry"]["segments"]
+                        )
                 print(json.dumps({"observability": obs}))
             elif args.base:
                 print(

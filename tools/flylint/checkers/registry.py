@@ -45,6 +45,7 @@ FAULTS = "flyimg_tpu/testing/faults.py"
 EXCEPTIONS = "flyimg_tpu/exceptions.py"
 APP = "flyimg_tpu/service/app.py"
 CHAOS = "tools/smoke_chaos.py"
+TELEMETRY = "flyimg_tpu/runtime/telemetry.py"
 OPTIONS_DOC = "docs/application-options.md"
 OBSERVABILITY_DOC = "docs/observability.md"
 
@@ -61,6 +62,8 @@ RULE_EXC_UNMAPPED = "exception-unmapped"
 RULE_EXC_UNKNOWN = "exception-map-unknown"
 RULE_CHAOS_UNCOVERED = "chaos-uncovered"
 RULE_CHAOS_UNKNOWN = "chaos-point-unknown"
+RULE_TELEMETRY_UNDOCUMENTED = "telemetry-field-undocumented"
+RULE_TELEMETRY_DOC_UNKNOWN = "telemetry-doc-unknown"
 
 _METRIC_METHODS = {"counter": "counter", "gauge": "gauge",
                    "histogram": "histogram"}
@@ -136,6 +139,14 @@ class RegistryChecker:
         RULE_CHAOS_UNKNOWN: (
             "CAMPAIGN_POINTS lists a point KNOWN_POINTS does not declare"
         ),
+        RULE_TELEMETRY_UNDOCUMENTED: (
+            "a RECORD_SCHEMAS archive field has no row in the "
+            "docs/observability.md archive record schema table"
+        ),
+        RULE_TELEMETRY_DOC_UNKNOWN: (
+            "the archive record schema table documents a field that "
+            "RECORD_SCHEMAS does not declare"
+        ),
     }
 
     def run(self, project: Project) -> Iterable[Finding]:
@@ -144,6 +155,7 @@ class RegistryChecker:
         yield from self._check_chaos_coverage(project)
         yield from self._check_metrics(project)
         yield from self._check_exceptions(project)
+        yield from self._check_telemetry_schema(project)
 
     # -- appconfig knobs ---------------------------------------------------
 
@@ -609,5 +621,113 @@ class RegistryChecker:
                     message=(
                         f"_ERROR_STATUS maps `{name}`, which "
                         "exceptions.py does not define"
+                    ),
+                )
+
+    # -- telemetry archive schema ------------------------------------------
+
+    def _record_schemas(
+        self, project: Project
+    ) -> Optional[Tuple[Dict[Tuple[str, str], int], int]]:
+        """(kind, field) -> lineno from runtime/telemetry.py's
+        RECORD_SCHEMAS literal, plus the dict's own line. None when the
+        module or the literal is absent (fixture runs stay inert)."""
+        src = project.get(TELEMETRY)
+        if src is None or src.tree is None:
+            return None
+        for node in ast.walk(src.tree):
+            target = None
+            if isinstance(node, ast.AnnAssign) and isinstance(
+                node.target, ast.Name
+            ):
+                target = node.target.id
+            elif isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "RECORD_SCHEMAS"
+                for t in node.targets
+            ):
+                target = "RECORD_SCHEMAS"
+            if target != "RECORD_SCHEMAS" or not isinstance(
+                node.value, ast.Dict
+            ):
+                continue
+            pairs: Dict[Tuple[str, str], int] = {}
+            for key, value in zip(node.value.keys, node.value.values):
+                kind = literal_str(key) if key is not None else None
+                if kind is None or not isinstance(
+                    value, (ast.Tuple, ast.List)
+                ):
+                    continue
+                for elt in value.elts:
+                    field = literal_str(elt)
+                    if field is not None:
+                        pairs[(kind, field)] = elt.lineno
+            return pairs, node.lineno
+        return None
+
+    def _doc_schema_rows(
+        self, project: Project
+    ) -> Dict[Tuple[str, str], int]:
+        """(kind, field) -> lineno from the OBSERVABILITY_DOC archive
+        record schema table: rows `| \\`kind\\` | \\`field\\` | ... |`
+        under the 'Archive record schema' heading, ending at the next
+        heading."""
+        doc = project.read_text(OBSERVABILITY_DOC)
+        rows: Dict[Tuple[str, str], int] = {}
+        if doc is None:
+            return rows
+        in_section = False
+        for lineno, line in enumerate(doc.splitlines(), start=1):
+            if line.startswith("#") and "Archive record schema" in line:
+                in_section = True
+                continue
+            if in_section and line.startswith("#"):
+                break
+            if not in_section or not line.startswith("|"):
+                continue
+            cells = line.split("|")
+            if len(cells) < 3:
+                continue
+            kinds = re.findall(r"`([a-z_]+)`", cells[1])
+            fields = re.findall(r"`([a-z0-9_]+)`", cells[2])
+            if len(kinds) == 1 and fields:
+                for field in fields:
+                    rows[(kinds[0], field)] = lineno
+        return rows
+
+    def _check_telemetry_schema(self, project: Project) -> Iterable[Finding]:
+        """RECORD_SCHEMAS <-> documented record table parity, both
+        directions. The archive is an operator-facing durable format:
+        a field emitted but not documented is data no query tool
+        contract covers; a documented field the code never emits is an
+        operator promise the archive silently broke."""
+        found = self._record_schemas(project)
+        if found is None:
+            return
+        code_pairs, schemas_line = found
+        doc_pairs = self._doc_schema_rows(project)
+        for (kind, field), lineno in sorted(code_pairs.items()):
+            if (kind, field) not in doc_pairs:
+                yield Finding(
+                    rule=RULE_TELEMETRY_UNDOCUMENTED,
+                    path=TELEMETRY,
+                    line=lineno,
+                    symbol="RECORD_SCHEMAS",
+                    message=(
+                        f"archive record field `{kind}.{field}` has no "
+                        f"row in the {OBSERVABILITY_DOC} archive record "
+                        "schema table"
+                    ),
+                )
+        for (kind, field), lineno in sorted(doc_pairs.items()):
+            if (kind, field) not in code_pairs:
+                yield Finding(
+                    rule=RULE_TELEMETRY_DOC_UNKNOWN,
+                    path=OBSERVABILITY_DOC,
+                    line=lineno,
+                    symbol="Archive record schema",
+                    message=(
+                        f"the record schema table documents "
+                        f"`{kind}.{field}`, which RECORD_SCHEMAS does "
+                        "not declare"
                     ),
                 )
