@@ -323,10 +323,34 @@ def _telemetry_stamp(line: str) -> str:
     return line
 
 
+def _memory_stamp(line: str) -> str:
+    """Stamp the process's peak RSS into the final JSON record (self +
+    children, so subprocess bench modes count too) — the memory
+    governor's capacity planning reads real bench footprints, not
+    guesses. Best-effort like the telemetry stamp: any failure leaves
+    the line untouched."""
+    try:
+        record = json.loads(line)
+        if not isinstance(record, dict):
+            return line
+        import resource
+
+        # ru_maxrss is KiB on Linux
+        peak_kib = max(
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss,
+            resource.getrusage(resource.RUSAGE_CHILDREN).ru_maxrss,
+        )
+        record["peak_rss_bytes"] = int(peak_kib) * 1024
+        return json.dumps(record)
+    except Exception:
+        return line
+
+
 def _emit_final(line: str) -> None:
     """THE single exit point for the supervisor's one promised JSON line:
     print it AND append it to the history trajectory."""
     line = _telemetry_stamp(line)
+    line = _memory_stamp(line)
     print(line)
     _append_history(line)
 

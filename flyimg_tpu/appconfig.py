@@ -157,6 +157,41 @@ SERVER_DEFAULTS: Dict[str, Any] = {
     # bounded batcher drain on graceful shutdown (readiness flips to 503
     # first so load balancers stop routing during the drain)
     "shutdown_drain_timeout_s": 30.0,
+    # --- memory governor (runtime/memgovernor.py; docs/resilience.md
+    # "Memory governor"). Default OFF: disabled the batcher holds no
+    # governor, the handler holds no byte accountant, brownout carries
+    # no RSS signal — byte-identical serving ---
+    # master switch for device-side launch admission: footprint
+    # prediction (cost-ledger memory_analysis estimate, else the
+    # bytes-per-padded-pixel heuristic), pre-split caps, AIMD capacity
+    # ceilings discovered from OOM-class launch failures
+    "mem_governor_enable": False,
+    # predicted-peak-HBM budget one launch must fit (pre-split over it);
+    # 0 = no static budget (ceilings discovered from OOMs still apply)
+    "mem_device_budget_bytes": 0,
+    # fallback prediction for never-compiled plan families:
+    # padded_batch * H * W * this many bytes per padded input pixel
+    "mem_heuristic_bytes_per_pixel": 64.0,
+    # a family's OOM-discovered capacity ceiling expires after this long
+    # without reinforcement; the AIMD probe can raise it back sooner
+    "mem_ceiling_ttl_s": 300.0,
+    # consecutive clean launches at a ceiling before the additive raise,
+    # and how many members each raise adds back
+    "mem_probe_successes": 4,
+    "mem_probe_step": 1,
+    # host-side byte accountant: max predicted decoded bytes (header
+    # sniffed w*h*3) inflight across fetch/decode/encode before decode
+    # admissions shed 503 + Retry-After; 0 disables the bound
+    "mem_host_budget_bytes": 0,
+    # RSS watchdog: process RSS normalized against this limit feeds the
+    # brownout engine as a pressure signal (1.0 = at the limit); 0
+    # disables the watchdog
+    "mem_rss_limit_bytes": 0,
+    # source bomb guards (413 before allocation): max encoded source
+    # bytes accepted from any origin, and max source pixel count
+    # (header-sniffed width*height) accepted into any decode path
+    "mem_max_source_bytes": 256 * 1024 * 1024,
+    "mem_max_source_pixels": 512 * 1024 * 1024,
     # --- backend supervisor (runtime/devicesupervisor.py;
     # docs/resilience.md "Backend failover"). Default OFF: disabled the
     # batcher carries no supervisor reference, no metrics register, no
@@ -563,6 +598,10 @@ SERVER_DEFAULTS: Dict[str, Any] = {
     # monotonic: archive records are compared across restarts, the
     # same reasoning as fleet_membership_clock
     "telemetry_clock": None,
+    # injectable monotonic clock for the memory governor's ceiling TTL
+    # / probe bookkeeping (runtime/memgovernor.py from_params) — same
+    # hook style as brownout_clock
+    "mem_clock": None,
 }
 
 

@@ -30,6 +30,15 @@ from PIL import Image, ImageOps
 Image.MAX_IMAGE_PIXELS = 512 * 1024 * 1024  # guard decompression bombs at 512MP
 
 
+def set_max_pixels(limit: int) -> None:
+    """Re-bound PIL's decompression-bomb guard from the
+    ``mem_max_source_pixels`` server knob (service/app.py make_app).
+    <= 0 keeps the module default above rather than disabling the guard:
+    an unbounded decoder defeats the memory governor's whole point."""
+    if int(limit) > 0:
+        Image.MAX_IMAGE_PIXELS = int(limit)
+
+
 @dataclass
 class DecodedImage:
     """Host-side decoded image + metadata the pipeline needs."""

@@ -62,6 +62,16 @@ class ServiceUnavailableException(AppException):
     retry_after_s: int = 1
 
 
+class PayloadTooLargeException(AppException):
+    """The source exceeds a configured byte or pixel bound
+    (``mem_max_source_bytes`` / ``mem_max_source_pixels``,
+    docs/resilience.md "Memory governor"): rejected from the header
+    sniff, BEFORE the full body is buffered or decoded, so one
+    pathological source cannot balloon host memory. Maps to 413 — the
+    request, not the service, is over the limit, and retrying the same
+    bytes will never succeed."""
+
+
 class DeadlineExceededException(AppException):
     """The per-request latency budget (runtime/resilience.py Deadline) ran
     out mid-pipeline. Maps to 504: the request fails fast instead of

@@ -67,6 +67,7 @@ from flyimg_tpu.ops.compose import (
 from flyimg_tpu.ops.resample import kernel_mode, select_band_taps
 from flyimg_tpu.runtime import costledger, tracing
 from flyimg_tpu.runtime.resilience import (
+    OVERSIZE,
     POISON,
     TRANSIENT,
     QuarantineTable,
@@ -224,6 +225,10 @@ class _Group:
     # the un-suffixed key is carried separately or a re-offender would be
     # fingerprinted under a key no later submission can ever match
     base_key: Optional[Tuple] = None
+    # memory-governor pre-split (runtime/memgovernor.py): the member cap
+    # this launch was held to by the HBM budget / family ceiling, None
+    # when admission didn't constrain the pop
+    mem_cap: Optional[int] = None
 
 
 class BatchController:
@@ -248,6 +253,7 @@ class BatchController:
         flight_recorder=None,
         profiler=None,
         supervisor=None,
+        governor=None,
     ) -> None:
         from flyimg_tpu.runtime.metrics import (
             MetricsRegistry,
@@ -292,6 +298,12 @@ class BatchController:
         # (PR-3's job) from a backend-failure STORM (its job). None —
         # the default, and always the codec controller — is zero-cost.
         self.supervisor = supervisor
+        # memory governor (runtime/memgovernor.py): consulted before
+        # launch for a pre-split member cap, fed launch outcomes for its
+        # AIMD capacity ceilings. None — the default, and always the
+        # codec controller — is zero-cost: no prediction, no caps, the
+        # disabled path is byte-identical.
+        self.governor = governor
         self._ledger = costledger.get_ledger()
         # admission control: "pending" = submitted and not yet resolved
         # (queued OR executing). When the bound is hit, submit sheds with
@@ -1089,8 +1101,22 @@ class BatchController:
         if best is None:
             return None
         group = self._groups[best]
-        take = group.members[:max_batch]
-        group.members = group.members[max_batch:]
+        take_n = min(max_batch, len(group.members))
+        mem_cap = None
+        if group.runner is None and self.governor is not None:
+            # memory-governor admission (runtime/memgovernor.py): cap
+            # the take so the PADDED launch's predicted peak HBM fits
+            # the device budget and the family's capacity ceiling — the
+            # remainder stays queued and pops as its own smaller launch
+            cap = self.governor.member_cap(
+                group.base_key or group.key, group.in_shape, take_n,
+                self._padded_batch,
+            )
+            if cap is not None and cap < take_n:
+                mem_cap = take_n = cap
+                self.governor.record_presplit()
+        take = group.members[:take_n]
+        group.members = group.members[take_n:]
         if not group.members:
             self._groups.pop(best, None)
         ready = _Group(
@@ -1105,6 +1131,7 @@ class BatchController:
             band_taps=group.band_taps,
             runner=group.runner,
             base_key=group.base_key,
+            mem_cap=mem_cap,
         )
         return ready
 
@@ -1161,12 +1188,30 @@ class BatchController:
                        device_s: Optional[float] = None,
                        compile_hit: Optional[bool] = None,
                        kind: str = "primary",
-                       error: Optional[str] = None) -> None:
+                       error: Optional[str] = None,
+                       mem_event: Optional[str] = None) -> None:
         """One flight-recorder entry per launch resolution (primary,
         recovery, aux, and failures alike). No recorder wired -> one
-        None check; the record itself is a dict append."""
+        None check; the record itself is a dict append. With a memory
+        governor attached, every device-launch record also carries the
+        predicted peak HBM vs the configured budget, and ``mem_event``
+        tags governor interventions (``presplit``/``ceiling`` launches,
+        ``oversize`` failures) so post-incident triage can replay the
+        admission decisions from the flight alone."""
         if self.flight_recorder is None:
             return
+        predicted_bytes = budget_bytes = None
+        if (
+            self.governor is not None
+            and self.governor.enabled
+            and group.runner is None
+        ):
+            predicted_bytes = self.governor.predict_bytes(
+                group.base_key or group.key, batch, group.in_shape
+            )
+            budget_bytes = self.governor.device_budget_bytes or None
+        if mem_event is None and group.mem_cap is not None:
+            mem_event = "presplit"
         self.flight_recorder.record(
             controller=self.name,
             batch_id=seq,
@@ -1182,6 +1227,9 @@ class BatchController:
             kind=kind,
             trace_id=self._member_trace_id(members),
             error=error,
+            predicted_bytes=predicted_bytes,
+            budget_bytes=budget_bytes,
+            mem_event=mem_event,
         )
 
     def _execute(self, group: _Group):
@@ -1285,6 +1333,11 @@ class BatchController:
         try:
             batch, arrays = self._assemble(group, members)
             fn, compile_hit = self._program(group, batch)
+            # fault hook: a plan raising an XLA-style RESOURCE_EXHAUSTED
+            # here models device OOM at dispatch — the failure routes
+            # through _recover's OVERSIZE branch (cap the family
+            # ceiling, re-launch smaller), never through quarantine
+            faults.fire("batcher.oom", key=group.key, n=n, batch=batch)
             span_obj = self._start_batch_span(
                 "device_execute", n, batch, members, seq=seq
             )
@@ -1293,6 +1346,11 @@ class BatchController:
                     "program.compile_cache", "hit" if compile_hit else "miss"
                 )
                 span_obj.set_attribute("program.in_shape", str(group.in_shape))
+                if group.mem_cap is not None:
+                    # the pre-split happened on the executor thread with
+                    # no ambient trace — the decision rides the shared
+                    # batch span into every member trace instead
+                    span_obj.add_event("mem.presplit", cap=group.mem_cap)
             # bound the pipeline: at most pipeline_depth batches between
             # dispatch and completed readback (memory + fairness).
             # Capture the semaphore INSTANCE: wedge self-healing may swap
@@ -1363,8 +1421,23 @@ class BatchController:
                 group, members, n=n, batch=batch, seq=seq,
                 queue_wait_s=queue_wait_s, fn=fn, compile_hit=compile_hit,
                 error=type(exc).__name__,
+                mem_event=(
+                    "oversize"
+                    if classify_batch_error(exc) == OVERSIZE else None
+                ),
             )
             self._recover(group, members, exc)
+
+    def _padded_batch(self, n: int) -> int:
+        """The padded device batch one launch of ``n`` members actually
+        dispatches: the power-of-two occupancy ladder, rounded up to a
+        multiple of the data axis (sharded execution needs the batch
+        divisible by it, and device counts are not necessarily powers of
+        two). Shared by ``_assemble`` and the memory governor's launch
+        admission, which must predict against the same padded size."""
+        batch = _round_batch(n)
+        nd = self._n_devices
+        return -(-batch // nd) * nd
 
     def _assemble(self, group: _Group, members: List[_Pending]):
         """Padded host arrays for ONE launch of ``members`` (shared by
@@ -1374,12 +1447,7 @@ class BatchController:
         (the real failure mode: the device cannot say WHICH input killed
         a fused batch program)."""
         n = len(members)
-        # sharded execution needs the batch divisible by the data axis —
-        # round the ladder size up to a multiple of it (device counts are
-        # not necessarily powers of two)
-        batch = _round_batch(n)
-        nd = self._n_devices
-        batch = -(-batch // nd) * nd
+        batch = self._padded_batch(n)
         bh, bw = group.in_shape
         # dynamic-rotate groups widen in_true with the host-computed
         # rotated output extent (ops/compose.py make_program_fn)
@@ -1508,6 +1576,16 @@ class BatchController:
                 h2d_s=h2d_s, dispatch_s=dispatch_s, sync_s=sync_s,
                 trace_id=trace_id,
             )
+            if self.governor is not None and fn is not None:
+                # governor feedback on the drain side: a completed
+                # readback is the "this batch size fits" signal — and the
+                # ledger's compile-time peak estimate (if the family ever
+                # compiled) refines the per-member prediction
+                family = group.base_key or group.key
+                self.governor.observe(
+                    family, batch, self._ledger.peak_memory(fn.ledger_key)
+                )
+                self.governor.record_success(family, n)
             if fn is not None and device_s is not None:
                 # per-plan attribution: cumulative device seconds against
                 # the program key the cost ledger costed at compile time
@@ -1561,6 +1639,10 @@ class BatchController:
                 queue_wait_s=queue_wait_s, fn=fn, h2d_s=h2d_s,
                 dispatch_s=dispatch_s, compile_hit=compile_hit,
                 error=type(exc).__name__,
+                mem_event=(
+                    "oversize"
+                    if classify_batch_error(exc) == OVERSIZE else None
+                ),
             )
             self._recover(group, members, exc)
         finally:
@@ -1601,6 +1683,9 @@ class BatchController:
             span_obj.set_attribute("recovery.class", kind)
         status = "ok"
         try:
+            if kind == OVERSIZE:
+                self._recover_oversize(group, live, exc, span_obj)
+                return
             if kind == TRANSIENT and self.batch_retries > 0:
                 exc = self._retry_batch(group, live, exc, span_obj)
                 if exc is None:
@@ -1655,6 +1740,98 @@ class BatchController:
             self._resolve_members(group, members, outputs)
             return None
         return last
+
+    def _recover_oversize(self, group: _Group, live: List[_Pending],
+                          exc: Exception, span_obj) -> None:
+        """OOM-class (RESOURCE_EXHAUSTED) launch failure: the error
+        indicts the LAUNCH footprint, not any member — so cap the plan
+        family's capacity ceiling (the governor halves it and later
+        re-probes upward) and re-launch the same members in smaller
+        pieces. A singleton that still OOMs cannot shrink further: it
+        fails with a deterministic 503 + Retry-After and is NEVER
+        quarantined — the same input may well fit once the ceiling
+        expires or HBM pressure clears (docs/resilience.md "Memory
+        governor")."""
+        cap = None
+        if self.governor is not None:
+            cap = self.governor.record_oom(
+                group.base_key or group.key, len(live)
+            )
+        if span_obj is not None:
+            span_obj.add_event(
+                "mem.ceiling", cap=cap, size=len(live),
+                error=type(exc).__name__,
+            )
+        if len(live) == 1:
+            self._fail_oversize(live[0], exc)
+            return
+        self._split_oversize(group, live, span_obj)
+
+    def _fail_oversize(self, member: _Pending, exc: Exception) -> None:
+        """Terminal OOM failure of ONE member: a capacity condition, not
+        an input property — the member maps to 503 + Retry-After (retry
+        is the correct client move once the ceiling re-probes) and never
+        enters quarantine."""
+        if member.future.done():
+            return
+        from flyimg_tpu.exceptions import ServiceUnavailableException
+
+        failure = ServiceUnavailableException(
+            "device memory exhausted at the smallest possible launch; "
+            "the plan family's capacity ceiling was capped — retry "
+            "shortly"
+        )
+        failure.__cause__ = exc
+        member.future.set_exception(failure)
+
+    def _split_oversize(self, group: _Group, members: List[_Pending],
+                        span_obj, depth: int = 0) -> None:
+        """Halving re-launch for an OOM'd batch. Unlike bisection this
+        is not a search — EVERY member is presumed innocent; a half that
+        still OOMs halves again (tightening the governor's ceiling each
+        time), down to singletons. Non-OOM errors surfaced by a smaller
+        launch hand off to the existing transient-retry / poison-bisect
+        machinery."""
+        if span_obj is not None:
+            span_obj.add_event("mem.split", size=len(members), depth=depth)
+        mid = len(members) // 2
+        for part in (members[:mid], members[mid:]):
+            live = [m for m in part if not m.future.done()]
+            if not live:
+                continue
+            try:
+                outputs = self._run_members(group, live)
+            except Exception as sub_exc:
+                kind = classify_batch_error(sub_exc)
+                if kind == OVERSIZE:
+                    if self.governor is not None:
+                        self.governor.record_oom(
+                            group.base_key or group.key, len(live)
+                        )
+                    if len(live) > 1:
+                        self._split_oversize(
+                            group, live, span_obj, depth + 1
+                        )
+                    else:
+                        self._fail_oversize(live[0], sub_exc)
+                    continue
+                if kind == TRANSIENT and self.batch_retries > 0:
+                    retried = self._retry_batch(
+                        group, live, sub_exc, span_obj
+                    )
+                    if retried is None:
+                        continue
+                    sub_exc = retried
+                    kind = classify_batch_error(sub_exc)
+                if kind == POISON and self.bisect_enable:
+                    if len(live) > 1:
+                        self._bisect(group, live, span_obj)
+                    else:
+                        self._fail_poison(group, live[0], sub_exc, span_obj)
+                    continue
+                self._fail_members(live, sub_exc)
+                continue
+            self._resolve_members(group, live, outputs)
 
     def _bisect(self, group: _Group, members: List[_Pending],
                 span_obj, depth: int = 0) -> None:
@@ -1765,6 +1942,10 @@ class BatchController:
             return outputs
         batch, arrays = self._assemble(group, members)
         fn, compile_hit = self._program(group, batch)
+        # same OOM fault hook as the primary path: recovery sub-launches
+        # can hit device memory exhaustion too, and must route through
+        # the same OVERSIZE handling in their caller
+        faults.fire("batcher.oom", key=group.key, n=n, batch=batch)
         if not compile_hit:
             self._suspend_busy()  # synchronous XLA compile ahead
         if self.profiler is not None:
@@ -1794,6 +1975,18 @@ class BatchController:
         self._ledger.record_launch(
             fn.ledger_key, device_s=device_s, images=n
         )
+        mem_event = None
+        if self.governor is not None:
+            # governor feedback: the ledger's compile-time peak estimate
+            # refines the per-member prediction, and a clean launch at a
+            # live ceiling counts toward the additive-raise probe
+            family = group.base_key or group.key
+            self.governor.observe(
+                family, batch, self._ledger.peak_memory(fn.ledger_key)
+            )
+            self.governor.record_success(family, n)
+            if self.governor.has_ceiling(family):
+                mem_event = "ceiling"
         self.metrics.record_batch_launch(
             self.name, images=n, capacity=batch, queue_wait_s=queue_wait_s,
             device_s=device_s, compile_hit=compile_hit, trace_id=trace_id,
@@ -1802,7 +1995,7 @@ class BatchController:
             group, members, n=n, batch=batch, seq=seq,
             queue_wait_s=queue_wait_s, fn=fn, h2d_s=h2d_s,
             dispatch_s=dispatch_s, sync_s=sync_s, device_s=device_s,
-            compile_hit=compile_hit, kind="recovery",
+            compile_hit=compile_hit, kind="recovery", mem_event=mem_event,
         )
         if self.supervisor is not None:
             # a completed recovery launch is backend evidence exactly

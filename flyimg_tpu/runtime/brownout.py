@@ -161,6 +161,7 @@ class BrownoutEngine:
         self._host_pipeline = None
         self._lease_waiters_fn: Optional[Callable[[], float]] = None
         self._device_supervisor = None
+        self._rss_fn: Optional[Callable[[], float]] = None
         self.refresh = RefreshQueue(
             max_pending=refresh_max_pending, metrics=metrics
         )
@@ -208,15 +209,20 @@ class BrownoutEngine:
 
     def attach(self, *, batchers=(), slo=None, inflight_fn=None,
                breaker_open_fn=None, host_pipeline=None,
-               lease_waiters_fn=None, device_supervisor=None) -> None:
+               lease_waiters_fn=None, device_supervisor=None,
+               rss_fn=None) -> None:
         """Wire the live pressure sources (service/app.py): batch
         controllers (queue depth + efficiency window), the SLO engine
         (burn rates), the inflight-request gauge, the breaker registry's
         open count, the host stage-DAG (runtime/hostpipeline.py — its
         worst stage-pool saturation, 1.0 = a stage at its admission
-        bound), and the L2 lease follower count (storage/tiered.py
+        bound), the L2 lease follower count (storage/tiered.py
         ``L2Lease.waiters`` — threads parked behind a remote leader are
-        load, not idleness). All optional — a missing source simply
+        load, not idleness), and the RSS watchdog's normalized process
+        memory pressure (runtime/memgovernor.py ``RssWatchdog.pressure``
+        — sampled on this engine's evaluation cadence, so approaching
+        the host memory limit degrades gracefully instead of ending in
+        the OOM killer). All optional — a missing source simply
         contributes no pressure."""
         self._batchers = tuple(batchers)
         self._slo = slo
@@ -224,6 +230,7 @@ class BrownoutEngine:
         self._breaker_open_fn = breaker_open_fn
         self._host_pipeline = host_pipeline
         self._lease_waiters_fn = lease_waiters_fn
+        self._rss_fn = rss_fn
         # the backend supervisor (runtime/devicesupervisor.py): a
         # replica failed over to CPU rendering carries a fixed pressure
         # so degradation (and the autotuner's BROWNOUT+ freeze guard
@@ -315,6 +322,16 @@ class BrownoutEngine:
                 out["l2_lease"] = (
                     float(self._lease_waiters_fn()) / self.lease_ref
                 )
+            except Exception:
+                pass
+        if self._rss_fn is not None:
+            try:
+                # process RSS vs the configured host memory limit
+                # (runtime/memgovernor.py): sampled here so memory
+                # pressure rides the same evaluation cadence — and the
+                # same stale-serve → degrade → shed ladder — as every
+                # other overload signal
+                out["rss"] = float(self._rss_fn())
             except Exception:
                 pass
         # a failing pressure source degrades to no-signal: the engine

@@ -253,6 +253,19 @@ class PlanCostLedger:
 
     # -- read surface ------------------------------------------------------
 
+    def peak_memory(self, key) -> Optional[float]:
+        """The backend's ``memory_analysis()`` peak estimate for one
+        program, or None when the program never compiled (or its entry
+        was evicted, or the backend reported nothing). The memory
+        governor (runtime/memgovernor.py) consults this before launch to
+        predict whether a batch fits the device budget."""
+        digest = key if isinstance(key, str) else key_digest(key)
+        with self._lock:
+            entry = self._entries.get(digest)
+            if entry is None:
+                return None
+            return entry.peak_memory_bytes
+
     def entries(self) -> List[Dict[str, object]]:
         with self._lock:
             rows = [e.as_dict() for e in self._entries.values()]

@@ -111,6 +111,9 @@ class FlightRecorder:
         trace_id: Optional[str] = None,
         error: Optional[str] = None,
         stage: Optional[str] = None,
+        predicted_bytes: Optional[float] = None,
+        budget_bytes: Optional[int] = None,
+        mem_event: Optional[str] = None,
     ) -> None:
         """One launch outcome. Runs on the batcher's executor/drain
         threads — the body is one level sample plus a deque append.
@@ -118,7 +121,11 @@ class FlightRecorder:
         (runtime/hostpipeline.py): the per-stage queue-wait joins the
         device launches' h2d/dispatch/sync split in the same ring, so an
         incident dump shows where requests queued — host stage pools or
-        device — on one timeline."""
+        device — on one timeline. ``predicted_bytes``/``budget_bytes``/
+        ``mem_event`` come from the memory governor when one is wired
+        (runtime/memgovernor.py): predicted peak HBM vs the configured
+        budget, and which admission intervention — ``presplit``,
+        ``ceiling``, or an ``oversize`` failure — touched this launch."""
         level = None
         if self._level_fn is not None:
             try:
@@ -147,6 +154,11 @@ class FlightRecorder:
             "stage": stage,
             "trace_id": trace_id,
             "error": error,
+            "predicted_bytes": (
+                round(predicted_bytes) if predicted_bytes else None
+            ),
+            "budget_bytes": budget_bytes,
+            "mem_event": mem_event,
         }
         with self._lock:
             self._seq += 1
@@ -262,6 +274,9 @@ class FlightRecorder:
             "compile_misses": sum(1 for hit in compiled if not hit),
             "recovery_launches": sum(
                 1 for r in records if r.get("kind") == "recovery"
+            ),
+            "mem_interventions": sum(
+                1 for r in records if r.get("mem_event") is not None
             ),
         }
 

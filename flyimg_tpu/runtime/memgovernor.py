@@ -1,0 +1,517 @@
+"""Two-sided memory governor: HBM-aware launch admission + host bounds.
+
+The resilience stack survives dead origins, poisoned members, dead
+devices, and dead shared tiers — this module makes it survive running
+out of memory, on both sides of the PCIe link:
+
+**Device side** (``MemoryGovernor``): before the batcher dispatches a
+group it asks whether the launch's predicted peak HBM fits
+``mem_device_budget_bytes``. The prediction prefers the cost ledger's
+``memory_analysis()`` estimate for the program family (scaled per padded
+batch member — ``runtime/costledger.py`` records it at compile time) and
+falls back to a bytes-per-padded-pixel heuristic for never-compiled
+families. An over-budget group is *pre-split* into smaller launches by
+capping how many members one launch takes (the remainder stays queued),
+instead of discovering OOM the hard way. A launch that still fails with
+an OOM-class error (``classify_batch_error`` == ``OVERSIZE``,
+``runtime/resilience.py``) records a TTL'd **capacity ceiling** for the
+plan family; an AIMD probe path (additive raise after sustained success
+at the ceiling, halve on OOM) re-discovers capacity after the condition
+clears — the same prober/flap-damping idiom as the backend supervisor.
+
+**Host side** (``HostByteAccountant``): a byte-denominated admission
+gate bounding total inflight *decoded* bytes across the
+fetch/decode/encode pipeline. The handler charges the header-sniffed
+predicted footprint (``w*h*3``) before the full decode and releases it
+after encode, so a burst of 4k-source misses sheds with a deterministic
+503 + Retry-After instead of OOM-killing the process. The first unit of
+work always admits — one huge image must degrade, not deadlock.
+
+``RssWatchdog``: samples process RSS (``/proc/self/statm``) and exposes
+it as normalized pressure the BrownoutEngine consumes on its evaluation
+cadence (``attach(rss_fn=...)``), so approaching the host memory limit
+walks the graceful stale-serve → plan degrade → shed ladder. The
+``mem.rss`` fault point overrides the sampled value for chaos drills.
+
+Everything here is default-off and inert when disabled: the batcher
+skips every governor call when it holds no governor, the handler skips
+the accountant, and brownout carries no RSS component — the disabled
+serving path is byte-identical (pinned by tests/test_memgovernor.py).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from flyimg_tpu.testing import faults
+
+
+def _family_label(key) -> str:
+    """Compact stable label for one plan-family key (for snapshots)."""
+    try:
+        from flyimg_tpu.runtime.costledger import key_digest
+
+        return key_digest(key)
+    except Exception:
+        return repr(key)
+
+
+class MemoryGovernor:
+    """HBM launch admission: footprint prediction, pre-split caps, and
+    AIMD capacity ceilings per plan family.
+
+    Thread-safe; the batcher calls into it from its executor and drain
+    threads. Clock injectable (``mem_clock``) for deterministic TTL and
+    probe tests.
+    """
+
+    def __init__(
+        self,
+        *,
+        enabled: bool = False,
+        device_budget_bytes: int = 0,
+        heuristic_bytes_per_pixel: float = 64.0,
+        ceiling_ttl_s: float = 300.0,
+        probe_successes: int = 4,
+        probe_step: int = 1,
+        metrics=None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.enabled = bool(enabled)
+        self.device_budget_bytes = max(int(device_budget_bytes), 0)
+        self.heuristic_bytes_per_pixel = max(
+            float(heuristic_bytes_per_pixel), 1.0
+        )
+        self.ceiling_ttl_s = max(float(ceiling_ttl_s), 0.0)
+        self.probe_successes = max(int(probe_successes), 1)
+        self.probe_step = max(int(probe_step), 1)
+        self._metrics = metrics
+        self._clock = clock
+        self._lock = threading.Lock()
+        # family digest -> conservative per-padded-member peak bytes
+        # learned from the cost ledger's compile-time memory_analysis()
+        self._per_member: Dict[str, float] = {}
+        # family digest -> [cap_members, expires_at, successes_at_cap]
+        self._ceilings: Dict[str, list] = {}
+        self._presplits_total = 0
+        self._oom_launches_total = 0
+
+    @classmethod
+    def from_params(cls, params, *, metrics=None) -> "MemoryGovernor":
+        # clock injectable through the (non-YAML) `mem_clock` object
+        # param, the same hook style as `brownout_clock`, so ceiling
+        # TTL / probe tests never sleep
+        clock = params.by_key("mem_clock") or time.monotonic
+        return cls(
+            enabled=bool(params.by_key("mem_governor_enable", False)),
+            device_budget_bytes=int(
+                params.by_key("mem_device_budget_bytes", 0) or 0
+            ),
+            heuristic_bytes_per_pixel=float(
+                params.by_key("mem_heuristic_bytes_per_pixel", 64.0)
+            ),
+            ceiling_ttl_s=float(params.by_key("mem_ceiling_ttl_s", 300.0)),
+            probe_successes=int(params.by_key("mem_probe_successes", 4)),
+            probe_step=int(params.by_key("mem_probe_step", 1)),
+            metrics=metrics,
+            clock=clock,
+        )
+
+    def register_metrics(self, registry) -> None:
+        """Governor families on the shared registry. Only called when
+        enabled (service/app.py) — a disabled app carries no
+        ``flyimg_mem_*`` device-side series."""
+        registry.counter(
+            "flyimg_mem_presplits_total",
+            "Device launches split below the requested batch by the "
+            "memory governor's budget/ceiling admission",
+        )
+        registry.counter(
+            "flyimg_mem_oom_launches_total",
+            "Device launches that failed with an OOM-class "
+            "(RESOURCE_EXHAUSTED) error",
+        )
+        registry.gauge(
+            "flyimg_mem_ceilings_active",
+            "Plan families currently carrying a TTL'd capacity ceiling",
+            fn=lambda: float(self.active_ceilings()),
+        )
+
+    # -- prediction --------------------------------------------------------
+
+    def observe(self, family, padded_batch: int,
+                peak_bytes: Optional[float]) -> None:
+        """Learn from one compiled program: the ledger's peak estimate
+        for a launch of ``padded_batch`` members. Keeps the maximum
+        per-member figure seen (small batches amortize fixed overhead
+        worst, so max is the conservative scaling model)."""
+        if not self.enabled or not peak_bytes or padded_batch <= 0:
+            return
+        per_member = float(peak_bytes) / float(padded_batch)
+        digest = _family_label(family)
+        with self._lock:
+            prev = self._per_member.get(digest, 0.0)
+            if per_member > prev:
+                self._per_member[digest] = per_member
+
+    def predict_bytes(self, family, padded_batch: int,
+                      in_shape: Optional[Tuple[int, int]]) -> float:
+        """Predicted peak HBM for one launch: ledger-learned per-member
+        bytes when the family ever compiled, else the
+        bytes-per-padded-pixel heuristic over the padded input."""
+        digest = _family_label(family)
+        with self._lock:
+            per_member = self._per_member.get(digest)
+        if per_member is not None:
+            return per_member * float(padded_batch)
+        if not in_shape:
+            return 0.0
+        h, w = int(in_shape[0]), int(in_shape[1])
+        return (
+            float(padded_batch) * h * w * self.heuristic_bytes_per_pixel
+        )
+
+    # -- launch admission (pre-split) --------------------------------------
+
+    def member_cap(
+        self,
+        family,
+        in_shape: Optional[Tuple[int, int]],
+        requested: int,
+        pad_fn: Callable[[int], int],
+    ) -> Optional[int]:
+        """Largest member count <= ``requested`` whose padded launch
+        fits the device budget AND the family's active ceiling, or None
+        when nothing constrains the launch. ``pad_fn`` maps a member
+        count to the padded batch actually dispatched (bucket rounding +
+        device-count alignment are the batcher's business)."""
+        if not self.enabled or requested <= 1:
+            return None
+        cap = int(requested)
+        ceiling = self._ceiling_cap(family)
+        if ceiling is not None:
+            cap = min(cap, max(int(ceiling), 1))
+        if self.device_budget_bytes > 0:
+            while cap > 1 and self.predict_bytes(
+                family, pad_fn(cap), in_shape
+            ) > self.device_budget_bytes:
+                cap -= 1
+        return cap if cap < requested else None
+
+    def record_presplit(self) -> None:
+        with self._lock:
+            self._presplits_total += 1
+        if self._metrics is not None:
+            self._metrics.counter(
+                "flyimg_mem_presplits_total",
+                "Device launches split below the requested batch by the "
+                "memory governor's budget/ceiling admission",
+            ).inc()
+
+    # -- AIMD capacity ceilings --------------------------------------------
+
+    def _ceiling_cap(self, family) -> Optional[int]:
+        digest = _family_label(family)
+        with self._lock:
+            entry = self._expire_locked(digest)
+            return None if entry is None else entry[0]
+
+    def _expire_locked(self, digest: str) -> Optional[list]:
+        entry = self._ceilings.get(digest)
+        if entry is None:
+            return None
+        if self.ceiling_ttl_s > 0 and self._clock() >= entry[1]:
+            del self._ceilings[digest]
+            self._probe_outcome("expire")
+            return None
+        return entry
+
+    def record_oom(self, family, n_members: int) -> int:
+        """One OOM-class launch failure: halve (or establish) the
+        family's capacity ceiling, refresh its TTL, and return the new
+        cap. Works even when admission is budget-less — the ceiling IS
+        the discovered capacity."""
+        n = max(int(n_members), 1)
+        digest = _family_label(family)
+        with self._lock:
+            self._oom_launches_total += 1
+            entry = self._expire_locked(digest)
+            if entry is None:
+                cap = max(n // 2, 1)
+            else:
+                cap = max(min(entry[0], n) // 2, 1)
+            self._ceilings[digest] = [
+                cap, self._clock() + self.ceiling_ttl_s, 0,
+            ]
+        if self._metrics is not None:
+            self._metrics.counter(
+                "flyimg_mem_oom_launches_total",
+                "Device launches that failed with an OOM-class "
+                "(RESOURCE_EXHAUSTED) error",
+            ).inc()
+        self._probe_outcome("halve")
+        return cap
+
+    def record_success(self, family, n_members: int) -> None:
+        """One clean launch: launches at (or above) a live ceiling count
+        toward the additive-raise probe — after ``probe_successes``
+        consecutive clean launches the cap rises by ``probe_step``,
+        re-discovering capacity without waiting out the TTL."""
+        if not self.enabled:
+            return
+        digest = _family_label(family)
+        raised = False
+        with self._lock:
+            entry = self._expire_locked(digest)
+            if entry is None or int(n_members) < entry[0]:
+                return
+            entry[2] += 1
+            if entry[2] >= self.probe_successes:
+                entry[0] += self.probe_step
+                entry[1] = self._clock() + self.ceiling_ttl_s
+                entry[2] = 0
+                raised = True
+        if raised:
+            self._probe_outcome("raise")
+
+    def has_ceiling(self, family) -> bool:
+        return self._ceiling_cap(family) is not None
+
+    def active_ceilings(self) -> int:
+        with self._lock:
+            now = self._clock()
+            if self.ceiling_ttl_s > 0:
+                return sum(
+                    1 for entry in self._ceilings.values()
+                    if now < entry[1]
+                )
+            return len(self._ceilings)
+
+    def _probe_outcome(self, outcome: str) -> None:
+        if self._metrics is not None:
+            self._metrics.counter(
+                f'flyimg_mem_ceiling_probes_total{{outcome="{outcome}"}}',
+                "Capacity-ceiling lifecycle events: halve on OOM, "
+                "additive raise on sustained success, TTL expire",
+            ).inc()
+
+    # -- introspection -----------------------------------------------------
+
+    def snapshot(self) -> Dict[str, object]:
+        """The /debug/memory governor section."""
+        with self._lock:
+            now = self._clock()
+            ceilings = {
+                digest: {
+                    "cap_members": entry[0],
+                    "ttl_remaining_s": round(max(entry[1] - now, 0.0), 3),
+                    "successes_at_cap": entry[2],
+                }
+                for digest, entry in self._ceilings.items()
+                if self.ceiling_ttl_s <= 0 or now < entry[1]
+            }
+            return {
+                "enabled": self.enabled,
+                "device_budget_bytes": self.device_budget_bytes,
+                "heuristic_bytes_per_pixel": self.heuristic_bytes_per_pixel,
+                "per_member_bytes": dict(self._per_member),
+                "ceilings": ceilings,
+                "presplits_total": self._presplits_total,
+                "oom_launches_total": self._oom_launches_total,
+            }
+
+
+class HostByteAccountant:
+    """Byte-denominated admission for decode work: at most
+    ``budget_bytes`` of predicted decoded footprint inflight at once;
+    over that, ``admit`` sheds instantly with a 503 + Retry-After
+    instead of queueing into an OOM kill. The first unit always admits
+    (a single over-budget image must degrade elsewhere, not deadlock
+    here). ``budget_bytes`` <= 0 disables the bound."""
+
+    def __init__(
+        self,
+        *,
+        budget_bytes: int = 0,
+        retry_after_s: float = 1.0,
+        metrics=None,
+    ) -> None:
+        self.budget_bytes = max(int(budget_bytes), 0)
+        self.retry_after_s = float(retry_after_s)
+        self._metrics = metrics
+        self._lock = threading.Lock()
+        self._inflight_bytes = 0
+        self._inflight_units = 0
+        self._rejections_total = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.budget_bytes > 0
+
+    @classmethod
+    def from_params(cls, params, *, metrics=None) -> "HostByteAccountant":
+        return cls(
+            budget_bytes=int(
+                params.by_key("mem_host_budget_bytes", 0) or 0
+            ),
+            retry_after_s=float(params.by_key("shed_retry_after_s", 1.0)),
+            metrics=metrics,
+        )
+
+    def register_metrics(self, registry) -> None:
+        registry.gauge(
+            "flyimg_mem_inflight_decoded_bytes",
+            "Predicted decoded bytes currently admitted through the "
+            "host byte accountant",
+            fn=lambda: float(self.inflight_bytes),
+        )
+        registry.counter(
+            "flyimg_mem_host_rejections_total",
+            "Decode admissions shed by the host byte budget",
+        )
+
+    def admit(self, predicted_bytes: int) -> int:
+        """Charge one unit of decode work; returns the charged byte
+        count (the token ``release`` takes back — 0 when disabled).
+        Raises ServiceUnavailableException when the budget is full."""
+        if not self.enabled:
+            return 0
+        charge = max(int(predicted_bytes), 0)
+        with self._lock:
+            if (
+                self._inflight_units > 0
+                and self._inflight_bytes + charge > self.budget_bytes
+            ):
+                self._rejections_total += 1
+                if self._metrics is not None:
+                    self._metrics.counter(
+                        "flyimg_mem_host_rejections_total",
+                        "Decode admissions shed by the host byte budget",
+                    ).inc()
+                    self._metrics.record_shed("host-memory")
+                from flyimg_tpu.exceptions import (
+                    ServiceUnavailableException,
+                )
+                from flyimg_tpu.runtime import tracing
+
+                tracing.add_event(
+                    "shed", reason="host-memory",
+                    inflight_bytes=self._inflight_bytes,
+                    predicted_bytes=charge,
+                    budget_bytes=self.budget_bytes,
+                )
+                exc = ServiceUnavailableException(
+                    "host decode byte budget is full "
+                    f"({self._inflight_bytes}/{self.budget_bytes} bytes "
+                    f"inflight, next unit needs {charge}); shedding load"
+                )
+                exc.retry_after_s = max(1, int(self.retry_after_s))
+                raise exc
+            self._inflight_bytes += charge
+            self._inflight_units += 1
+        return charge
+
+    def release(self, charged: int) -> None:
+        """Return one admit()'s charge. Call from a finally block — a
+        leaked charge shrinks the budget until restart."""
+        with self._lock:
+            if self._inflight_units > 0:
+                self._inflight_units -= 1
+            self._inflight_bytes = max(
+                self._inflight_bytes - max(int(charged), 0), 0
+            )
+
+    @property
+    def inflight_bytes(self) -> int:
+        with self._lock:
+            return self._inflight_bytes
+
+    @property
+    def inflight_units(self) -> int:
+        with self._lock:
+            return self._inflight_units
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "budget_bytes": self.budget_bytes,
+                "inflight_bytes": self._inflight_bytes,
+                "inflight_units": self._inflight_units,
+                "rejections_total": self._rejections_total,
+            }
+
+
+class RssWatchdog:
+    """Process-RSS sampler feeding the brownout engine. ``pressure()``
+    returns RSS / ``limit_bytes`` normalized so 1.0 ~ at the limit —
+    attached via ``BrownoutEngine.attach(rss_fn=watchdog.pressure)`` it
+    is sampled on the brownout evaluation cadence. The ``mem.rss`` fault
+    point lets chaos drills script the sampled value."""
+
+    _PAGE_SIZE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+
+    def __init__(self, *, limit_bytes: int = 0, metrics=None) -> None:
+        self.limit_bytes = max(int(limit_bytes), 0)
+        self._metrics = metrics
+        self._peak_bytes = 0.0
+
+    @property
+    def enabled(self) -> bool:
+        return self.limit_bytes > 0
+
+    @classmethod
+    def from_params(cls, params, *, metrics=None) -> "RssWatchdog":
+        return cls(
+            limit_bytes=int(params.by_key("mem_rss_limit_bytes", 0) or 0),
+            metrics=metrics,
+        )
+
+    def register_metrics(self, registry) -> None:
+        registry.gauge(
+            "flyimg_mem_rss_bytes",
+            "Process resident set size, sampled at scrape time",
+            fn=lambda: float(self.rss_bytes()),
+        )
+
+    def rss_bytes(self) -> float:
+        """Current RSS in bytes (0.0 when unreadable). A planned
+        ``mem.rss`` fault overrides the sample — chaos drills force
+        memory pressure without allocating it."""
+        forced = faults.fire("mem.rss")
+        if forced is not faults.PASS and forced is not None:
+            rss = float(forced)
+        else:
+            rss = self._read_statm()
+        if rss > self._peak_bytes:
+            self._peak_bytes = rss
+        return rss
+
+    def _read_statm(self) -> float:
+        try:
+            with open("/proc/self/statm", "r", encoding="ascii") as fh:
+                fields = fh.read().split()
+            return float(fields[1]) * float(self._PAGE_SIZE)
+        except (OSError, IndexError, ValueError):
+            return 0.0
+
+    def pressure(self) -> float:
+        """RSS normalized against the limit (0.0 when disabled)."""
+        if not self.enabled:
+            return 0.0
+        return self.rss_bytes() / float(self.limit_bytes)
+
+    @property
+    def peak_bytes(self) -> float:
+        return self._peak_bytes
+
+    def snapshot(self) -> Dict[str, object]:
+        return {
+            "enabled": self.enabled,
+            "limit_bytes": self.limit_bytes,
+            "rss_bytes": self.rss_bytes(),
+            "peak_bytes": self._peak_bytes,
+        }

@@ -483,44 +483,56 @@ class AdmissionGate:
 #: batch-error classes: a TRANSIENT error is a property of the device /
 #: runtime moment (retrying the same batch can succeed); a POISON error is
 #: a property of some member's input (retrying whole fails identically —
-#: only bisection down to the offending member helps)
+#: only bisection down to the offending member helps); an OVERSIZE error
+#: is a property of the LAUNCH FOOTPRINT (every member is innocent — the
+#: batch as shaped does not fit device memory, so splitting it into
+#: smaller launches helps and quarantining member digests never does)
 TRANSIENT = "transient"
 POISON = "poison"
+OVERSIZE = "oversize"
 
 # plain-Python transport/IO failures: the device runtime's host side
 # (TimeoutError/ConnectionError are OSError subclasses; listed for clarity)
 _TRANSIENT_EXC_TYPES = (OSError, TimeoutError, ConnectionError)
 
 # XLA/JAX runtime errors carry an absl status code in the message. Codes
-# that indicate the INPUT (or the program built from it) is at fault —
-# including RESOURCE_EXHAUSTED: bisection halves the batch footprint, so
-# treating OOM as poison lets the innocent halves complete and pins the
-# failure on the smallest set that still overflows.
+# that indicate the INPUT (or the program built from it) is at fault.
+# RESOURCE_EXHAUSTED is deliberately NOT here: an HBM OOM indicts the
+# launch footprint, not any member — it classifies OVERSIZE so the
+# batcher re-launches in smaller pieces (and the memory governor caps the
+# plan family's capacity ceiling) instead of bisecting innocent images
+# into the quarantine table.
 _POISON_STATUS_MARKERS = (
     "INVALID_ARGUMENT",
-    "RESOURCE_EXHAUSTED",
     "FAILED_PRECONDITION",
     "OUT_OF_RANGE",
     "UNIMPLEMENTED",
 )
 
+#: absl status codes that mean "this launch did not fit device memory"
+_OVERSIZE_STATUS_MARKERS = ("RESOURCE_EXHAUSTED", "OUT_OF_MEMORY")
+
 
 def classify_batch_error(exc: BaseException) -> str:
-    """Classify one device-batch failure as ``TRANSIENT`` or ``POISON``.
+    """Classify one device-batch failure as ``TRANSIENT``, ``POISON``,
+    or ``OVERSIZE``.
 
     XLA runtime errors (matched by MRO class name — the concrete type's
     import location moves between jaxlib versions) are transient unless
-    their status code marks the program/input at fault; host-side IO
-    errors are transient; everything else — ValueError from assembly,
-    injected member faults, arbitrary library errors — defaults to poison
-    so bisection can localize it. A wrong poison default costs bounded
-    extra launches and converges to the same per-member failure; a wrong
-    transient default would burn retries re-executing a deterministic
-    failure against the whole batch.
+    their status code marks the program/input at fault (poison) or the
+    launch footprint at fault (oversize: RESOURCE_EXHAUSTED / OOM);
+    host-side IO errors are transient; everything else — ValueError from
+    assembly, injected member faults, arbitrary library errors — defaults
+    to poison so bisection can localize it. A wrong poison default costs
+    bounded extra launches and converges to the same per-member failure;
+    a wrong transient default would burn retries re-executing a
+    deterministic failure against the whole batch.
     """
     names = {cls.__name__ for cls in type(exc).__mro__}
     if "XlaRuntimeError" in names or "JaxRuntimeError" in names:
         msg = str(exc).upper()
+        if any(marker in msg for marker in _OVERSIZE_STATUS_MARKERS):
+            return OVERSIZE
         if any(marker in msg for marker in _POISON_STATUS_MARKERS):
             return POISON
         return TRANSIENT

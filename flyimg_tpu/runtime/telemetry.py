@@ -94,6 +94,7 @@ RECORD_SCHEMAS: Dict[str, Tuple[str, ...]] = {
         "queue_wait_s", "h2d_s", "dispatch_s", "sync_s", "device_s",
         "compile_hit", "brownout_level", "launch_kind", "stage",
         "trace_id", "error", "launch_seq",
+        "predicted_bytes", "budget_bytes", "mem_event",
     ),
 }
 

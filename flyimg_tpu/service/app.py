@@ -19,8 +19,9 @@ batch-scoped device-profile captures), /debug/brownout (degradation
 level + pressure components), /debug/device (backend supervisor state:
 breaker, probes, failovers), /debug/autotune (online policy, envelopes,
 decision history), /debug/tier (shared-tier outage supervisor: island
-state, journal, scrubber), POST /debug/fleet/replicas (dynamic
-replica-set reload).
+state, journal, scrubber), /debug/memory (memory governor: capacity
+ceilings, host byte budget, RSS watchdog), POST /debug/fleet/replicas
+(dynamic replica-set reload).
 
 plus the ``encrypt`` CLI subcommand (reference app.php:93-96):
 
@@ -50,6 +51,7 @@ from flyimg_tpu.exceptions import (
     InvalidArgumentException,
     MissingParamsException,
     OriginUnavailableException,
+    PayloadTooLargeException,
     ReadFileException,
     SecurityException,
     ServiceUnavailableException,
@@ -111,6 +113,9 @@ _ERROR_STATUS = {
     # upstream, not this request, is the problem — a fast 502
     OriginUnavailableException: 502,
     ServiceUnavailableException: 503,
+    # source over the configured byte/pixel bound (runtime/memgovernor.py
+    # satellites): the request can never succeed — 413, not 503
+    PayloadTooLargeException: 413,
     ExecFailedException: 500,
     # server-side misconfiguration surfacing per-request (e.g. a signed
     # URL arriving with no security_key configured): our fault, 500 —
@@ -290,6 +295,31 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
     from flyimg_tpu.runtime.batcher import containment_params
 
     containment = containment_params(params)
+    # memory governor (runtime/memgovernor.py; docs/resilience.md
+    # "Memory governor"): HBM-aware launch admission + AIMD capacity
+    # ceilings (device side), a decode byte budget and an RSS→brownout
+    # watchdog (host side). Every piece is default off and inert — the
+    # batcher holds no governor, the handler no accountant, brownout no
+    # RSS source — so disabled serving is byte-identical (pinned by
+    # tests/test_memgovernor.py).
+    from flyimg_tpu.runtime.memgovernor import (
+        HostByteAccountant,
+        MemoryGovernor,
+        RssWatchdog,
+    )
+
+    from flyimg_tpu.codecs.pil_codec import set_max_pixels
+
+    set_max_pixels(int(params.by_key("mem_max_source_pixels", 0) or 0))
+    governor = MemoryGovernor.from_params(params, metrics=metrics)
+    mem_accountant = HostByteAccountant.from_params(params, metrics=metrics)
+    rss_watchdog = RssWatchdog.from_params(params, metrics=metrics)
+    if governor.enabled:
+        governor.register_metrics(metrics)
+    if mem_accountant.enabled:
+        mem_accountant.register_metrics(metrics)
+    if rss_watchdog.enabled:
+        rss_watchdog.register_metrics(metrics)
     # backend supervisor (runtime/devicesupervisor.py; docs/resilience.md
     # "Backend failover"): watches device-batch outcomes for a
     # classified-transient failure STORM, trips the backend breaker,
@@ -312,6 +342,7 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
         flight_recorder=flight_recorder,
         profiler=profiler,
         supervisor=supervisor if supervisor.enabled else None,
+        governor=governor if governor.enabled else None,
         **containment,
     )
     if supervisor.enabled:
@@ -418,6 +449,7 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
         brownout=brownout, host_pipeline=host_pipeline,
         device_supervisor=supervisor if supervisor.enabled else None,
         telemetry=telemetry if telemetry.enabled else None,
+        mem_accountant=mem_accountant if mem_accountant.enabled else None,
     )
     # shared-tier outage supervisor (runtime/tiersupervisor.py;
     # docs/resilience.md "Island mode"): watches L2 storage / lease /
@@ -510,6 +542,10 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
         # device_health pressure (docs/degradation.md "Device-loss
         # pressure") so degradation and the autotuner guard rail react
         device_supervisor=supervisor if supervisor.enabled else None,
+        # process RSS vs the host memory limit (runtime/memgovernor.py
+        # RssWatchdog): approaching the limit walks the same
+        # stale-serve → degrade → shed ladder as every other signal
+        rss_fn=rss_watchdog.pressure if rss_watchdog.enabled else None,
     )
     # online policy autotuner (runtime/autotuner.py; docs/autotuning.md):
     # closes the loop from the observatory (efficiency windows, SLO burn
@@ -1452,6 +1488,26 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
             content_type="application/json",
         )
 
+    async def debug_memory(_request: web.Request) -> web.Response:
+        """Memory governor state (runtime/memgovernor.py snapshots;
+        docs/resilience.md "Memory governor"): device-side prediction
+        model + active capacity ceilings, the host byte accountant's
+        inflight charge, and the RSS watchdog sample — the document an
+        operator checks when launches pre-split or decodes shed."""
+        import json as _json
+
+        denied = _debug_gate_404()
+        if denied is not None:
+            return denied
+        doc = {
+            "governor": governor.snapshot(),
+            "host": mem_accountant.snapshot(),
+            "rss": rss_watchdog.snapshot(),
+        }
+        return web.Response(
+            text=_json.dumps(doc), content_type="application/json"
+        )
+
     async def debug_fleet_status(_request: web.Request) -> web.Response:
         """One JSON snapshot of the whole fleet (docs/fleet.md "Fleet
         observatory & autoscaling signal"): every live signal digest,
@@ -1571,6 +1627,7 @@ def make_app(params: Optional[AppParameters] = None) -> web.Application:
     app.router.add_get("/debug/device", debug_device)
     app.router.add_get("/debug/autotune", debug_autotune)
     app.router.add_get("/debug/tier", debug_tier)
+    app.router.add_get("/debug/memory", debug_memory)
     app.router.add_get("/debug/fleet", debug_fleet)
     app.router.add_get("/debug/fleet/status", debug_fleet_status)
     app.router.add_post("/debug/fleet/replicas", debug_fleet_replicas)

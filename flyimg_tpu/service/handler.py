@@ -28,6 +28,7 @@ from flyimg_tpu.codecs import decode, encode, media_info
 from flyimg_tpu.codecs.sniff import sniff
 from flyimg_tpu.exceptions import (
     DeadlineExceededException,
+    PayloadTooLargeException,
     ServiceUnavailableException,
 )
 from flyimg_tpu.ops.compose import run_plan
@@ -148,6 +149,7 @@ class ImageHandler:
         host_pipeline=None,
         device_supervisor=None,
         telemetry=None,
+        mem_accountant=None,
     ) -> None:
         self.storage = storage
         self.params = params
@@ -247,6 +249,17 @@ class ImageHandler:
             self.l2lease = L2Lease.from_params(
                 params, storage=storage.shared
             )
+        # host byte accountant (runtime/memgovernor.py): decode work
+        # charges its header-sniffed footprint (w*h*3) before the full
+        # decode and releases after. None (mem_host_budget_bytes 0, the
+        # default) = no charge calls, byte-identical miss path.
+        self.mem_accountant = mem_accountant
+        # header-sniff pixel bound: over it, the miss rejects as 413
+        # BEFORE decode allocates anything (0 = unbounded; PIL's
+        # decompression-bomb guard still applies either way)
+        self.max_source_pixels = int(
+            params.by_key("mem_max_source_pixels", 0) or 0
+        )
 
     def _stage(self, name: str, fn, deadline: Optional[Deadline],
                *, inline_fallback: bool = True):
@@ -1498,6 +1511,48 @@ class ImageHandler:
         )
 
     def _process_new(
+        self,
+        data: bytes,
+        options: OptionsBag,
+        spec: OutputSpec,
+        timings: Dict[str, float],
+        deadline: Optional[Deadline] = None,
+        degrade=None,
+        degraded_out: Optional[List[str]] = None,
+        render_info: Optional[Dict[str, object]] = None,
+    ) -> bytes:
+        """Memory-governed admission around the miss pipeline
+        (runtime/memgovernor.py; docs/resilience.md "Memory governor"):
+        header-sniff the decoded footprint BEFORE anything allocates —
+        a source over ``mem_max_source_pixels`` rejects as 413, and the
+        host byte accountant charges ``w*h*3`` until the render ends
+        (releases in a finally: an exception must not leak budget).
+        With both knobs off (the default) this adds nothing and the
+        pipeline below runs exactly as before."""
+        charge = None
+        if self.mem_accountant is not None or self.max_source_pixels > 0:
+            info = media_info(data)
+            if info.width and info.height:
+                pixels = int(info.width) * int(info.height)
+                if 0 < self.max_source_pixels < pixels:
+                    raise PayloadTooLargeException(
+                        f"source is {info.width}x{info.height} "
+                        f"({pixels} px), over the mem_max_source_pixels "
+                        f"bound of {self.max_source_pixels}"
+                    )
+                if self.mem_accountant is not None:
+                    charge = self.mem_accountant.admit(pixels * 3)
+        try:
+            return self._process_new_inner(
+                data, options, spec, timings, deadline=deadline,
+                degrade=degrade, degraded_out=degraded_out,
+                render_info=render_info,
+            )
+        finally:
+            if charge is not None:
+                self.mem_accountant.release(charge)
+
+    def _process_new_inner(
         self,
         data: bytes,
         options: OptionsBag,

@@ -48,7 +48,18 @@ from flyimg_tpu.runtime.resilience import (
 from flyimg_tpu.spec.options import OptionsBag
 from flyimg_tpu.testing import faults
 
+#: default transfer bound; the ``mem_max_source_bytes`` server knob
+#: overrides it per app through ``FetchPolicy.max_source_bytes``
+#: (docs/resilience.md "Memory governor")
 MAX_SOURCE_BYTES = 256 * 1024 * 1024
+
+
+def _source_byte_cap(policy: Optional["FetchPolicy"]) -> int:
+    """The effective source byte bound: the policy's configured
+    ``mem_max_source_bytes`` when set, else the module default."""
+    if policy is not None and policy.max_source_bytes > 0:
+        return int(policy.max_source_bytes)
+    return MAX_SOURCE_BYTES
 
 # transient transport failures: worth a retry, and they count against the
 # upstream's circuit breaker. Anything else (4xx except 429, protocol-level
@@ -99,6 +110,9 @@ class FetchPolicy:
     # TTL'd negative origin cache (runtime/brownout.py NegativeCache):
     # None/disabled keeps today's fetch path untouched
     negative: Optional[NegativeCache] = None
+    # source transfer bound (``mem_max_source_bytes``); 0 = the module
+    # default MAX_SOURCE_BYTES
+    max_source_bytes: int = 0
 
     def __post_init__(self) -> None:
         if self.retry is None:
@@ -144,6 +158,9 @@ class FetchPolicy:
                 if negative_ttl > 0
                 else None
             ),
+            max_source_bytes=int(
+                params.by_key("mem_max_source_bytes", 0) or 0
+            ),
         )
 
 
@@ -171,13 +188,15 @@ def _http_fetch_once(
     headers: dict,
     timeout: httpx.Timeout,
     deadline: Optional[Deadline] = None,
+    max_bytes: Optional[int] = None,
 ) -> bytes:
     """ONE fetch attempt, streaming the body so the transfer aborts the
-    moment it exceeds MAX_SOURCE_BYTES (instead of buffering a hostile
+    moment it exceeds the byte cap (instead of buffering a hostile
     origin's response whole) and the moment the request budget dies (the
     per-read timeout alone cannot stop a slow-drip origin that sends one
     chunk every few seconds forever). The retry/breaker wrappers live in
     fetch_original; injected faults fire here so they are subject to both."""
+    cap = max_bytes if max_bytes else MAX_SOURCE_BYTES
     injected = faults.fire("fetch.http", url=image_url)
     if injected is not faults.PASS:
         return injected
@@ -190,9 +209,9 @@ def _http_fetch_once(
     ) as resp:
         resp.raise_for_status()
         length = resp.headers.get("Content-Length")
-        if length and length.isdigit() and int(length) > MAX_SOURCE_BYTES:
+        if length and length.isdigit() and int(length) > cap:
             raise ReadFileException(
-                f"source exceeds {MAX_SOURCE_BYTES} bytes"
+                f"source exceeds {cap} bytes"
             )
         chunks = []
         total = 0
@@ -200,9 +219,9 @@ def _http_fetch_once(
             if deadline is not None:
                 deadline.check("fetch")
             total += len(chunk)
-            if total > MAX_SOURCE_BYTES:
+            if total > cap:
                 raise ReadFileException(
-                    f"source exceeds {MAX_SOURCE_BYTES} bytes"
+                    f"source exceeds {cap} bytes"
                 )
             chunks.append(chunk)
         return b"".join(chunks)
@@ -241,11 +260,12 @@ def fetch_original(
         # local path "URL" (reference tests use these throughout)
         if not os.path.exists(image_url):
             raise ReadFileException(f"Unable to read file: {image_url}")
+        cap = _source_byte_cap(policy)
         with open(image_url, "rb") as fh:
-            data = fh.read(MAX_SOURCE_BYTES + 1)
-        if len(data) > MAX_SOURCE_BYTES:
+            data = fh.read(cap + 1)
+        if len(data) > cap:
             raise ReadFileException(
-                f"source exceeds {MAX_SOURCE_BYTES} bytes"
+                f"source exceeds {cap} bytes"
             )
     else:
         policy = policy if policy is not None else FetchPolicy()
@@ -294,7 +314,8 @@ def fetch_original(
             # or the probe slot leaks and the breaker wedges half-open
             try:
                 data = _http_fetch_once(
-                    image_url, headers, httpx_timeout, deadline
+                    image_url, headers, httpx_timeout, deadline,
+                    max_bytes=_source_byte_cap(policy),
                 )
             except BaseException as exc:
                 if is_transient_fetch_error(exc):

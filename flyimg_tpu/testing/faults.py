@@ -98,6 +98,20 @@ through:
                         raising plan models the shared tier refusing the
                         manifest — seeding degrades to a cold boot,
                         publishing retries on a later beat
+    ``batcher.oom``     one device launch about to dispatch (primary
+                        executor AND recovery sub-launches), ctx
+                        ``key``/``n``/``batch``; a plan raising an
+                        XLA-style RESOURCE_EXHAUSTED error forces the
+                        OOM-class (OVERSIZE) recovery path — the batcher
+                        must cap the family's capacity ceiling and
+                        re-launch in smaller pieces, never quarantine
+                        (runtime/memgovernor.py, docs/resilience.md
+                        "Memory governor")
+    ``mem.rss``         one RSS watchdog sample (runtime/memgovernor.py
+                        RssWatchdog.rss_bytes): a plan returning a float
+                        OVERRIDES the /proc-sampled byte count, so chaos
+                        drills force memory pressure through the
+                        brownout ladder without allocating it
 
 Production cost is one module-level ``None`` check per point (no injector
 installed -> ``fire`` returns ``PASS`` immediately). Tests install a
@@ -152,6 +166,8 @@ KNOWN_POINTS = frozenset({
     "l2.storage",
     "fleet.member",
     "warmstart.cache",
+    "batcher.oom",
+    "mem.rss",
 })
 
 #: sentinel: "no plan fired — run the real code path"

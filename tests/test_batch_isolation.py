@@ -25,6 +25,7 @@ from flyimg_tpu.ops.compose import run_plan
 from flyimg_tpu.runtime.batcher import BatchController, _image_digest
 from flyimg_tpu.runtime.metrics import MetricsRegistry
 from flyimg_tpu.runtime.resilience import (
+    OVERSIZE,
     POISON,
     TRANSIENT,
     QuarantineTable,
@@ -99,9 +100,15 @@ def test_classification_transient_vs_poison():
     assert classify_batch_error(
         XlaRuntimeError("INVALID_ARGUMENT: bad shape")
     ) == POISON
+    # OOM-class device errors indict the launch FOOTPRINT, not a member:
+    # they take the oversize recovery path (halve + capacity ceiling,
+    # runtime/memgovernor.py), never bisection/quarantine
     assert classify_batch_error(
         XlaRuntimeError("RESOURCE_EXHAUSTED: hbm oom")
-    ) == POISON
+    ) == OVERSIZE
+    assert classify_batch_error(
+        XlaRuntimeError("OUT_OF_MEMORY: allocator")
+    ) == OVERSIZE
 
 
 # ---------------------------------------------------------------------------

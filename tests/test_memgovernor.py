@@ -1,0 +1,533 @@
+"""Memory governor tests (runtime/memgovernor.py; docs/resilience.md
+"Memory governor"): HBM footprint prediction, pre-split launch
+admission, AIMD capacity ceilings under an injectable clock, the host
+byte accountant, the RSS watchdog feeding brownout, and the service
+wiring — including the two acceptance pins: an injected OOM on a batch
+of 8 resolves every member with zero quarantine, and the disabled
+governor is byte-identical to the seed serving path."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from flyimg_tpu.appconfig import AppParameters
+from flyimg_tpu.codecs import encode
+from flyimg_tpu.ops.compose import run_plan
+from flyimg_tpu.runtime.batcher import BatchController
+from flyimg_tpu.runtime.flightrecorder import FlightRecorder
+from flyimg_tpu.runtime.memgovernor import (
+    HostByteAccountant,
+    MemoryGovernor,
+    RssWatchdog,
+)
+from flyimg_tpu.runtime.metrics import MetricsRegistry
+from flyimg_tpu.spec.options import OptionsBag
+from flyimg_tpu.spec.plan import build_plan
+from flyimg_tpu.testing import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.clear()
+
+
+def _run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+class FakeClock:
+    def __init__(self, start: float = 1000.0) -> None:
+        self.now = start
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, seconds: float) -> None:
+        self.now += seconds
+
+
+def _gov(**over):
+    kw = dict(
+        enabled=True,
+        heuristic_bytes_per_pixel=1.0,
+        ceiling_ttl_s=300.0,
+        probe_successes=4,
+        probe_step=1,
+        clock=FakeClock(),
+    )
+    kw.update(over)
+    return MemoryGovernor(**kw)
+
+
+# ---------------------------------------------------------------------------
+# prediction
+
+
+def test_predict_heuristic_then_ledger_learned():
+    gov = _gov()
+    # never-compiled family: bytes-per-padded-pixel heuristic
+    assert gov.predict_bytes("fam", 8, (32, 32)) == 8 * 32 * 32 * 1.0
+    # no shape and no history -> no basis for a prediction
+    assert gov.predict_bytes("fam", 8, None) == 0.0
+    # a compile-time observation switches the family to the ledger model
+    gov.observe("fam", 8, 8000.0)
+    assert gov.predict_bytes("fam", 4, (32, 32)) == 4000.0
+    # the per-member figure is the MAX seen (conservative scaling)
+    gov.observe("fam", 8, 4000.0)
+    assert gov.predict_bytes("fam", 4, (32, 32)) == 4000.0
+
+
+# ---------------------------------------------------------------------------
+# launch admission (pre-split caps)
+
+
+def test_member_cap_walks_down_to_the_budget():
+    gov = _gov(device_budget_bytes=350)
+    # 100 heuristic bytes per member (10x10 @ 1 B/px), identity padding:
+    # 8 requested -> only 3 fit under 350
+    assert gov.member_cap("fam", (10, 10), 8, lambda n: n) == 3
+    # an unconstrained launch returns None, not the requested count
+    assert gov.member_cap("fam", (10, 10), 3, lambda n: n) is None
+    # singletons are never capped (floor of the walk-down is 1)
+    assert gov.member_cap("fam", (10, 10), 1, lambda n: n) is None
+    big = _gov(device_budget_bytes=10**12)
+    assert big.member_cap("fam", (10, 10), 8, lambda n: n) is None
+
+
+def test_member_cap_respects_padding_function():
+    # pad to the next multiple of 4 (bucket rounding): 3 members pad to
+    # a 4-wide launch, so the cap must walk below the padded footprint
+    gov = _gov(device_budget_bytes=350)
+    pad4 = lambda n: -(-n // 4) * 4  # noqa: E731
+    # pad4(2) = 4 -> 400 bytes > 350; even 2 members exceed the budget,
+    # so the walk-down bottoms out at the 1-member floor
+    assert gov.member_cap("fam", (10, 10), 8, pad4) == 1
+
+
+def test_member_cap_disabled_is_none():
+    gov = _gov(enabled=False, device_budget_bytes=1)
+    assert gov.member_cap("fam", (10, 10), 8, lambda n: n) is None
+
+
+# ---------------------------------------------------------------------------
+# AIMD capacity ceilings (injectable clock)
+
+
+def test_ceiling_halves_probes_up_and_expires():
+    clock = FakeClock()
+    gov = _gov(clock=clock, ceiling_ttl_s=60.0, probe_successes=3)
+    # OOM at 8 members -> ceiling 4
+    assert gov.record_oom("fam", 8) == 4
+    assert gov.member_cap("fam", (10, 10), 8, lambda n: n) == 4
+    # sustained success at the cap probes the ceiling up additively
+    for _ in range(3):
+        gov.record_success("fam", 4)
+    assert gov.member_cap("fam", (10, 10), 8, lambda n: n) == 5
+    # a fresh OOM halves from the CURRENT cap, not the original
+    assert gov.record_oom("fam", 5) == 2
+    # successes below the cap do not count toward the probe
+    gov.record_success("fam", 1)
+    assert gov.member_cap("fam", (10, 10), 8, lambda n: n) == 2
+    # the TTL clears the ceiling without any probe traffic
+    clock.advance(61.0)
+    assert gov.has_ceiling("fam") is False
+    assert gov.member_cap("fam", (10, 10), 8, lambda n: n) is None
+
+
+def test_record_oom_caps_even_when_disabled():
+    """Satellite pin: the ceiling is DISCOVERED capacity — a singleton
+    RESOURCE_EXHAUSTED must cap the family even with admission off, so
+    the 503 is honest about when retrying can help."""
+    gov = _gov(enabled=False)
+    assert gov.record_oom("fam", 8) == 4
+    assert gov.has_ceiling("fam") is True
+    # admission stays off: the cap informs recovery, not dispatch
+    assert gov.member_cap("fam", (10, 10), 8, lambda n: n) is None
+
+
+def test_ceiling_floor_is_one_member():
+    gov = _gov()
+    assert gov.record_oom("fam", 1) == 1
+    assert gov.record_oom("fam", 1) == 1  # never halves below 1
+
+
+# ---------------------------------------------------------------------------
+# host byte accountant
+
+
+def test_accountant_admits_charges_and_sheds():
+    from flyimg_tpu.exceptions import ServiceUnavailableException
+
+    acct = HostByteAccountant(budget_bytes=100, retry_after_s=2.0)
+    charge = acct.admit(60)
+    assert charge == 60
+    assert acct.inflight_bytes == 60 and acct.inflight_units == 1
+    with pytest.raises(ServiceUnavailableException) as err:
+        acct.admit(60)
+    assert err.value.retry_after_s == 2
+    acct.release(charge)
+    assert acct.inflight_bytes == 0 and acct.inflight_units == 0
+    assert acct.snapshot()["rejections_total"] == 1
+
+
+def test_accountant_first_unit_always_admits():
+    # one over-budget image must degrade downstream, not deadlock here
+    acct = HostByteAccountant(budget_bytes=100)
+    charge = acct.admit(10_000)
+    assert charge == 10_000 and acct.inflight_units == 1
+    acct.release(charge)
+
+
+def test_accountant_disabled_is_free():
+    acct = HostByteAccountant(budget_bytes=0)
+    assert acct.enabled is False
+    assert acct.admit(10**9) == 0
+    assert acct.inflight_bytes == 0 and acct.inflight_units == 0
+    acct.release(0)
+
+
+def test_accountant_release_floors_at_zero():
+    acct = HostByteAccountant(budget_bytes=100)
+    acct.release(50)  # spurious release must not go negative
+    assert acct.inflight_bytes == 0 and acct.inflight_units == 0
+
+
+# ---------------------------------------------------------------------------
+# RSS watchdog + brownout wiring
+
+
+def test_rss_watchdog_pressure_and_fault_override():
+    faults.install(faults.FaultInjector()).plan(
+        "mem.rss", lambda **_ctx: 75.0
+    )
+    dog = RssWatchdog(limit_bytes=100)
+    assert dog.pressure() == 0.75
+    assert dog.peak_bytes == 75.0
+    assert dog.snapshot()["rss_bytes"] == 75.0
+    # disabled (no limit): no pressure signal, sampling still works
+    off = RssWatchdog(limit_bytes=0)
+    assert off.enabled is False and off.pressure() == 0.0
+
+
+def test_rss_watchdog_reads_real_statm():
+    dog = RssWatchdog(limit_bytes=1)
+    assert dog.rss_bytes() > 0.0  # a live process has nonzero RSS
+
+
+def test_brownout_carries_the_rss_component():
+    from flyimg_tpu.runtime.brownout import BrownoutEngine
+
+    engine = BrownoutEngine(enabled=True, metrics=MetricsRegistry())
+    engine.attach(rss_fn=lambda: 0.9)
+    assert engine._components()["rss"] == 0.9
+    bare = BrownoutEngine(enabled=True, metrics=MetricsRegistry())
+    bare.attach()
+    assert "rss" not in bare._components()
+
+
+# ---------------------------------------------------------------------------
+# batcher integration
+
+SRC = (32, 32)
+
+
+def _plan(opts="w_16"):
+    return build_plan(OptionsBag(opts), *SRC)
+
+
+def _img(seed):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 200, (SRC[1], SRC[0], 3), dtype=np.uint8)
+
+
+def _ctl(**over):
+    kw = dict(
+        max_batch=8, deadline_ms=10_000.0, lone_flush=False,
+        quarantine_ttl_s=60.0, metrics=MetricsRegistry(),
+    )
+    kw.update(over)
+    ctl = BatchController(**kw)
+    ctl._retry_policy.sleep = lambda _s: None
+    return ctl
+
+
+def _oom_exc():
+    return type("XlaRuntimeError", (RuntimeError,), {})(
+        "RESOURCE_EXHAUSTED: out of memory while trying to allocate"
+    )
+
+
+def test_over_budget_group_presplits_into_smaller_launches():
+    """The acceptance pre-split: a group whose predicted footprint
+    exceeds the budget dispatches as multiple smaller launches, every
+    member still resolves pixel-identical to the lone path."""
+    gov = _gov(device_budget_bytes=3000)  # 1024 B/member @ 32x32
+    ctl = _ctl(max_batch=4, deadline_ms=50.0, governor=gov)
+    try:
+        imgs = [_img(i) for i in range(4)]
+        futures = [ctl.submit(img, _plan()) for img in imgs]
+        outs = [f.result(timeout=60) for f in futures]
+        for img, out in zip(imgs, outs):
+            np.testing.assert_array_equal(out, run_plan(img, _plan()))
+        snap = gov.snapshot()
+        assert snap["presplits_total"] >= 1
+        assert snap["oom_launches_total"] == 0
+    finally:
+        ctl.close()
+
+
+def test_oom_batch_of_8_recovers_everyone_no_quarantine():
+    """The acceptance chaos pin: RESOURCE_EXHAUSTED on the first launch
+    of an 8-member batch -> the oversize path halves and re-launches,
+    ALL 8 members return results, the quarantine stays empty, and the
+    family carries a capacity ceiling."""
+    faults.install(faults.FaultInjector()).plan(
+        "batcher.oom", faults.fail_n_then_succeed(1, _oom_exc)
+    )
+    gov = _gov()
+    rec = FlightRecorder(size=64)
+    ctl = _ctl(governor=gov, flight_recorder=rec)
+    try:
+        imgs = [_img(i) for i in range(8)]
+        futures = [ctl.submit(img, _plan()) for img in imgs]
+        outs = [f.result(timeout=60) for f in futures]
+        for img, out in zip(imgs, outs):
+            np.testing.assert_array_equal(out, run_plan(img, _plan()))
+        # nothing entered quarantine and nothing was called poison
+        assert ctl.quarantine._count == 0
+        text = ctl.metrics.render_prometheus()
+        assert "flyimg_poison_isolated_total" not in text
+        # the failure was recorded as an oversize event, not an error
+        # class that retries or bisects
+        events = [r.get("mem_event") for r in rec.snapshot()["records"]]
+        assert "oversize" in events
+        snap = gov.snapshot()
+        assert snap["oom_launches_total"] == 1
+        assert snap["ceilings"], "the family must carry a ceiling"
+        (ceiling,) = snap["ceilings"].values()
+        assert ceiling["cap_members"] == 4
+    finally:
+        ctl.close()
+
+
+def test_singleton_oom_fails_with_503_never_quarantines():
+    """Satellite pin: an OOM at the smallest possible launch is a
+    capacity condition — deterministic ServiceUnavailable (503 +
+    Retry-After at the edge), ceiling capped, and NO quarantine entry
+    for the member."""
+    from flyimg_tpu.exceptions import ServiceUnavailableException
+
+    faults.install(faults.FaultInjector()).plan(
+        "batcher.oom", lambda **_ctx: (_ for _ in ()).throw(_oom_exc())
+    )
+    gov = _gov()
+    ctl = _ctl(max_batch=1, governor=gov)
+    try:
+        future = ctl.submit(_img(0), _plan())
+        with pytest.raises(ServiceUnavailableException) as err:
+            future.result(timeout=60)
+        assert "memory" in str(err.value)
+        assert ctl.quarantine._count == 0
+        text = ctl.metrics.render_prometheus()
+        assert "flyimg_poison_isolated_total" not in text
+        # ceiling capped at the 1-member floor
+        snap = gov.snapshot()
+        (ceiling,) = snap["ceilings"].values()
+        assert ceiling["cap_members"] == 1
+    finally:
+        ctl.close()
+
+
+# ---------------------------------------------------------------------------
+# service wiring (make_app)
+
+
+def _write_src(tmp_path):
+    rng = np.random.default_rng(11)
+    src = tmp_path / "src.png"
+    src.write_bytes(
+        encode(rng.integers(0, 230, (48, 64, 3), dtype=np.uint8), "png")
+    )
+    return str(src)
+
+
+def _app_params(tmp_path, sub, **extra):
+    conf = {
+        "tmp_dir": str(tmp_path / sub / "t"),
+        "upload_dir": str(tmp_path / sub / "u"),
+        "batch_deadline_ms": 1.0,
+    }
+    conf.update(extra)
+    return AppParameters(conf)
+
+
+def test_default_off_is_byte_identical(tmp_path):
+    """Everything off (the default): no governor on the batcher, no
+    accountant on the handler, no flyimg_mem_* series — and the render
+    bytes match an enabled-but-unconstrained app exactly."""
+    from flyimg_tpu.service.app import HANDLER_KEY, make_app
+
+    src = _write_src(tmp_path)
+
+    async def go():
+        off = make_app(_app_params(tmp_path, "off"))
+        on = make_app(_app_params(
+            tmp_path, "on",
+            mem_governor_enable=True,
+            mem_device_budget_bytes=10**12,
+            mem_host_budget_bytes=10**12,
+            mem_rss_limit_bytes=10**12,
+        ))
+        assert off[HANDLER_KEY].batcher.governor is None
+        assert off[HANDLER_KEY].mem_accountant is None
+        assert on[HANDLER_KEY].batcher.governor is not None
+        assert on[HANDLER_KEY].mem_accountant is not None
+        c_off = TestClient(TestServer(off))
+        c_on = TestClient(TestServer(on))
+        await c_off.start_server()
+        await c_on.start_server()
+        try:
+            path = f"/upload/w_24,o_png/{src}"
+            r_off = await c_off.get(path)
+            r_on = await c_on.get(path)
+            assert r_off.status == 200 and r_on.status == 200
+            assert await r_off.read() == await r_on.read()
+            metrics = await (await c_off.get("/metrics")).text()
+            assert "flyimg_mem_" not in metrics
+            enabled_metrics = await (await c_on.get("/metrics")).text()
+            assert "flyimg_mem_presplits_total" in enabled_metrics
+            assert "flyimg_mem_inflight_decoded_bytes" in enabled_metrics
+            assert "flyimg_mem_rss_bytes" in enabled_metrics
+        finally:
+            await c_off.close()
+            await c_on.close()
+
+    _run(go())
+
+
+def test_pixel_guard_rejects_before_decode_with_413(tmp_path):
+    from flyimg_tpu.service.app import make_app
+
+    src = _write_src(tmp_path)  # 64x48 = 3072 px
+
+    async def go():
+        app = make_app(_app_params(
+            tmp_path, "px", mem_max_source_pixels=100,
+        ))
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            resp = await client.get(f"/upload/w_24,o_png/{src}")
+            assert resp.status == 413
+            assert "mem_max_source_pixels" in await resp.text()
+        finally:
+            await client.close()
+
+    _run(go())
+
+
+def test_host_budget_sheds_503_with_retry_after(tmp_path):
+    from flyimg_tpu.service.app import HANDLER_KEY, make_app
+
+    src = _write_src(tmp_path)
+
+    async def go():
+        app = make_app(_app_params(
+            tmp_path, "host", mem_host_budget_bytes=1000,
+        ))
+        acct = app[HANDLER_KEY].mem_accountant
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            # park one admitted unit so the budget is occupied; the
+            # 64x48 source predicts 9216 decoded bytes > what's left
+            charge = acct.admit(999)
+            try:
+                resp = await client.get(f"/upload/w_24,o_png/{src}")
+                assert resp.status == 503
+                assert resp.headers.get("Retry-After") == "1"
+            finally:
+                acct.release(charge)
+            metrics = await (await client.get("/metrics")).text()
+            assert "flyimg_mem_host_rejections_total 1" in metrics
+            assert 'flyimg_shed_total{reason="host-memory"} 1' in metrics
+            # once released, the same request renders fine and the
+            # charge is returned afterwards (no leak)
+            ok = await client.get(f"/upload/w_24,o_png/{src}")
+            assert ok.status == 200
+            assert acct.inflight_bytes == 0 and acct.inflight_units == 0
+        finally:
+            await client.close()
+
+    _run(go())
+
+
+def test_accountant_charge_released_when_the_render_fails(tmp_path):
+    """The admit/release pairing survives pipeline failure: a render
+    that dies after admission must return its charge."""
+    from flyimg_tpu.service.app import HANDLER_KEY, make_app
+
+    src = _write_src(tmp_path)
+
+    async def go():
+        injector = faults.FaultInjector()
+        injector.plan(
+            "batcher.oom",
+            lambda **_ctx: (_ for _ in ()).throw(_oom_exc()),
+        )
+        app = make_app(_app_params(
+            tmp_path, "leak",
+            mem_host_budget_bytes=10**9,
+            fault_injector=injector,
+        ))
+        acct = app[HANDLER_KEY].mem_accountant
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            resp = await client.get(f"/upload/w_24,o_png/{src}")
+            assert resp.status == 503
+            assert acct.inflight_bytes == 0 and acct.inflight_units == 0
+        finally:
+            await client.close()
+
+    _run(go())
+
+
+def test_debug_memory_gated_and_snapshots(tmp_path):
+    from flyimg_tpu.service.app import make_app
+
+    async def go():
+        gated = make_app(_app_params(tmp_path, "gated"))
+        on = make_app(_app_params(
+            tmp_path, "dbg", debug=True,
+            mem_governor_enable=True,
+            mem_rss_limit_bytes=10**12,
+        ))
+        c_gated = TestClient(TestServer(gated))
+        c_on = TestClient(TestServer(on))
+        await c_gated.start_server()
+        await c_on.start_server()
+        try:
+            assert (await c_gated.get("/debug/memory")).status == 404
+            resp = await c_on.get("/debug/memory")
+            assert resp.status == 200
+            doc = json.loads(await resp.text())
+            assert doc["governor"]["enabled"] is True
+            assert doc["host"]["enabled"] is False
+            assert doc["rss"]["enabled"] is True
+            assert doc["rss"]["rss_bytes"] > 0
+        finally:
+            await c_gated.close()
+            await c_on.close()
+
+    _run(go())

@@ -1021,6 +1021,22 @@ async def _scrape_observability(client: httpx.AsyncClient, base: str):
                 (telemetry_doc.get("archive") or {}).get("segments") or []
             ),
         }
+    # memory governor (runtime/memgovernor.py): pre-split/OOM counts
+    # and the target's peak RSS, so capacity rows carry the memory
+    # footprint next to the throughput — None when the endpoint 404s
+    # (debug off) or the governor never registered
+    memory_doc = await _get("/debug/memory")
+    memory = None
+    if isinstance(memory_doc, dict):
+        memory = {
+            "presplits_total": (
+                (memory_doc.get("governor") or {}).get("presplits_total")
+            ),
+            "oom_launches_total": (
+                (memory_doc.get("governor") or {}).get("oom_launches_total")
+            ),
+            "peak_rss_bytes": (memory_doc.get("rss") or {}).get("peak_bytes"),
+        }
     plan_costs = None
     if plans is not None:
         rows = plans.get("plans", [])
@@ -1051,6 +1067,7 @@ async def _scrape_observability(client: httpx.AsyncClient, base: str):
             recorder.get("summary") if recorder is not None else None
         ),
         "telemetry": telemetry,
+        "memory": memory,
     }
 
 
@@ -1607,6 +1624,15 @@ async def main() -> int:
                         row["traffic_mix"] = obs["telemetry"]["mix"]
                         row["telemetry_segments"] = (
                             obs["telemetry"]["segments"]
+                        )
+                    if obs.get("memory") is not None:
+                        # memory-footprint attribution: the target's
+                        # peak RSS and governor interventions
+                        row["peak_rss_bytes"] = (
+                            obs["memory"]["peak_rss_bytes"]
+                        )
+                        row["mem_presplits_total"] = (
+                            obs["memory"]["presplits_total"]
                         )
                 print(json.dumps({"observability": obs}))
             elif args.base:

@@ -13,7 +13,11 @@ probe raises), ``fleet.proxy`` (proxied owner GET fails),
 fails), ``fleet.member`` (membership marker read/write/confirm/list
 fails — heartbeats count failures and retry, serving never notices),
 ``warmstart.cache`` (manifest reads fail — the replica boots cold
-instead of warm) — × {NORMAL, BROWNOUT, ISLAND}, asserting the
+instead of warm), ``batcher.oom`` (the first device launch fails with
+RESOURCE_EXHAUSTED — the memory governor's oversize path maps it, caps
+the family ceiling, and nothing quarantines), ``mem.rss`` (a forced
+RSS sample drives the brownout ``rss`` pressure component) — ×
+{NORMAL, BROWNOUT, ISLAND}, asserting the
 standing invariants every time (the ISLAND level runs every point with
 the shared-tier supervisor tripped into island mode — L2 ops
 short-circuit locally, docs/resilience.md "Shared-tier outage
@@ -82,7 +86,7 @@ REQUEST_TIMEOUT_S = 120.0
 #: the campaign's fault points × degradation levels
 CAMPAIGN_POINTS = (
     "device.backend", "fleet.proxy", "l2.lease", "l2.storage",
-    "fleet.member", "warmstart.cache",
+    "fleet.member", "warmstart.cache", "batcher.oom", "mem.rss",
 )
 CAMPAIGN_LEVELS = ("normal", "brownout", "island")
 
@@ -172,6 +176,7 @@ async def _campaign_case(point: str, level: str) -> None:
             "tier_probe_interval_s": 60.0,
         })
     storm_statuses: set = set()
+    rss_limit = 1 << 30
     if point == "device.backend":
         # a dying backend: the first request's launch AND its recovery
         # retry fail (2 transient outcomes = the storm threshold), the
@@ -258,6 +263,30 @@ async def _campaign_case(point: str, level: str) -> None:
                 OSError("chaos: warm-start manifest unreadable")
             ) if op == "read" else faults.PASS,
         )
+    elif point == "batcher.oom":
+        # the first device launch fails with an OOM-class error: the
+        # governor's oversize recovery owns it — a singleton launch
+        # maps to 503 + Retry-After (capacity, never poison), the
+        # family ceiling caps, and nothing bisects or quarantines
+        conf["mem_governor_enable"] = True
+        injector.plan(
+            "batcher.oom",
+            faults.fail_n_then_succeed(
+                1,
+                lambda: type("XlaRuntimeError", (RuntimeError,), {})(
+                    "RESOURCE_EXHAUSTED: chaos hbm oom"
+                ),
+            ),
+        )
+    elif point == "mem.rss":
+        # a forced RSS sample: the watchdog exports it and feeds the
+        # brownout rss pressure component (half the limit — present as
+        # a signal, not high enough to degrade on its own)
+        conf.update({
+            "brownout_enable": True,
+            "mem_rss_limit_bytes": rss_limit,
+        })
+        injector.plan("mem.rss", lambda **_: float(rss_limit) * 0.5)
 
     rng = np.random.default_rng(7)
     src = os.path.join(tmp, "src.png")
@@ -307,6 +336,22 @@ async def _campaign_case(point: str, level: str) -> None:
                 supervisor.cpu_forced(),
                 f"{label} storm tripped the backend breaker",
             )
+        if point == "batcher.oom":
+            # the OOM-trigger request is a singleton launch, so the
+            # oversize path has nothing to split: a deterministic 503
+            # + Retry-After is the correct mapping (a multi-member
+            # batch instead resolves everyone — tests/test_memgovernor)
+            resp = await bounded_get(f"/upload/w_31,o_png/{src}")
+            _require(
+                resp.status in (200, 503),
+                f"{label} oom request mapped 200/503 "
+                f"(got {resp.status})",
+            )
+            if resp.status == 503:
+                _require(
+                    "Retry-After" in resp.headers,
+                    f"{label} oom 503 carries Retry-After",
+                )
         # seed one cached key, then re-request it: hits must serve 200
         # under EVERY fault (the seed render itself must also serve)
         seed = await bounded_get(f"/upload/w_33,o_png/{src}")
@@ -357,6 +402,25 @@ async def _campaign_case(point: str, level: str) -> None:
                     'flyimg_warmstart_programs_total{outcome="seeded"}',
                 ) == 0.0,
                 f"{label} nothing seeded through the fault",
+            )
+        if point == "batcher.oom":
+            text = await (await client.get("/metrics")).text()
+            _require(
+                _metric_value(text, "flyimg_mem_oom_launches_total")
+                >= 1.0,
+                f"{label} oom launch counted",
+            )
+            _require(
+                _metric_value(text, "flyimg_poison_isolated_total")
+                == 0.0,
+                f"{label} oom never bisected into quarantine",
+            )
+        if point == "mem.rss":
+            text = await (await client.get("/metrics")).text()
+            _require(
+                _metric_value(text, "flyimg_mem_rss_bytes")
+                == float(rss_limit) * 0.5,
+                f"{label} forced rss sample exported",
             )
         if tier_sup is not None:
             # island mode held through the traffic: L2 ops were

@@ -1,0 +1,334 @@
+"""CI memory-governor smoke (docs/resilience.md "Memory governor").
+
+Part 1 — the acceptance chaos proof, end to end through the HTTP
+service: the executor is wedged so 8 concurrent requests pile into ONE
+device batch, the batch's first launch fails with an injected
+``RESOURCE_EXHAUSTED`` (the ``batcher.oom`` fault point), and the
+governor's oversize recovery must resolve it:
+
+- every one of the 8 requests answers 200 with valid bytes,
+- nothing bisects and nothing quarantines (OOM indicts the launch
+  footprint, never a member),
+- the plan family carries a halved capacity ceiling, visible in the
+  debug-gated ``/debug/memory`` snapshot,
+- a second wedged batch of 8 against the same family *pre-splits* at
+  the ceiling instead of re-discovering OOM, and sustained success at
+  the cap re-probes it upward (the AIMD loop closes).
+
+Part 2 — host pressure: a forced ``mem.rss`` sample at 95% of
+``mem_rss_limit_bytes`` walks the brownout level up through the RSS
+pressure component, and a low sample walks it back down to NORMAL.
+
+    JAX_PLATFORMS=cpu python tools/smoke_memory.py
+
+Exit code 0 = every assertion held. Behavioral matrices live in
+tests/test_memgovernor.py; this script proves the wired-together
+service survives OOM-class failure, not just that the units do.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import tempfile
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+N_BATCH = 8
+REQUEST_TIMEOUT_S = 120.0
+
+
+def _require(cond: bool, what: str) -> None:
+    if not cond:
+        print(f"FAIL: {what}", file=sys.stderr)
+        raise SystemExit(1)
+
+
+def _metric_value(text: str, name: str) -> float:
+    for line in text.splitlines():
+        if line.startswith(name) and not line.startswith("#"):
+            try:
+                return float(line.rsplit(" ", 1)[1])
+            except ValueError:
+                continue
+    return 0.0
+
+
+def _oom_exc():
+    return type("XlaRuntimeError", (RuntimeError,), {})(
+        "RESOURCE_EXHAUSTED: smoke hbm oom"
+    )
+
+
+async def oom_recovery_smoke() -> None:
+    import numpy as np
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from flyimg_tpu.appconfig import AppParameters
+    from flyimg_tpu.codecs import encode
+    from flyimg_tpu.service.app import make_app
+    from flyimg_tpu.testing import faults
+
+    asyncio.get_running_loop().set_default_executor(
+        ThreadPoolExecutor(max_workers=2 * N_BATCH + 4)
+    )
+
+    tmp = tempfile.mkdtemp(prefix="flyimg-memsmoke-")
+    rng = np.random.default_rng(0)
+    sources = []
+    for i in range(2 * N_BATCH + 2):
+        path = os.path.join(tmp, f"src-{i}.png")
+        with open(path, "wb") as fh:
+            fh.write(
+                encode(
+                    rng.integers(0, 200, (48, 64, 3), dtype=np.uint8), "png"
+                )
+            )
+        sources.append(path)
+
+    injector = faults.FaultInjector()
+    # fail exactly the FIRST full-batch launch with an OOM-class error;
+    # the halved recovery launches (n=4) and every singleton pass
+    oom_state = {"fired": False}
+
+    def oom_plan(n=0, **_ctx):
+        if not oom_state["fired"] and n >= N_BATCH:
+            oom_state["fired"] = True
+            raise _oom_exc()
+        return faults.PASS
+
+    injector.plan("batcher.oom", oom_plan)
+    app = make_app(AppParameters({
+        "tmp_dir": os.path.join(tmp, "t"),
+        "upload_dir": os.path.join(tmp, "u"),
+        "batch_deadline_ms": 50.0,
+        "debug": True,
+        "mem_governor_enable": True,
+        "mem_probe_successes": 2,
+        "fault_injector": injector,
+    }))
+    client = TestClient(TestServer(app))
+    await client.start_server()
+
+    async def bounded(fut):
+        return await asyncio.wait_for(fut, timeout=REQUEST_TIMEOUT_S)
+
+    async def wedged_batch(holder_src, batch_srcs, round_label):
+        """Wedge the executor on a holder request, queue one batch of 8
+        behind it, open the gate, return the 8 responses."""
+        gate = threading.Event()
+        injector.plan("batcher.execute", faults.wedge_until(gate))
+        fired_before = injector.fired.get("batcher.execute", 0)
+        holder = asyncio.ensure_future(
+            client.get(f"/upload/w_40,o_png/{holder_src}")
+        )
+        try:
+            for _ in range(200):
+                await asyncio.sleep(0.02)
+                if injector.fired.get("batcher.execute", 0) > fired_before:
+                    break
+            _require(
+                injector.fired.get("batcher.execute", 0) > fired_before,
+                f"{round_label}: executor wedged on the holder",
+            )
+            futs = [
+                asyncio.ensure_future(
+                    client.get(f"/upload/w_32,o_png/{src}")
+                )
+                for src in batch_srcs
+            ]
+            depth = 0.0
+            for _ in range(300):
+                await asyncio.sleep(0.02)
+                text = await (await client.get("/metrics")).text()
+                depth = _metric_value(
+                    text,
+                    'flyimg_batcher_queue_depth{controller="device"}',
+                )
+                if depth >= N_BATCH:
+                    break
+            _require(
+                depth >= N_BATCH,
+                f"{round_label}: all {N_BATCH} submissions queued "
+                f"(saw {depth})",
+            )
+        finally:
+            gate.set()
+        await bounded(holder)
+        return [await bounded(fut) for fut in futs]
+
+    try:
+        # round 1: the full batch OOMs, recovery halves, everyone serves
+        responses = await wedged_batch(
+            sources[0], sources[1:1 + N_BATCH], "round 1"
+        )
+        for i, resp in enumerate(responses):
+            _require(
+                resp.status == 200,
+                f"round 1: request {i} served through the OOM "
+                f"(got {resp.status})",
+            )
+            body = await resp.read()
+            _require(
+                body[:8] == b"\x89PNG\r\n\x1a\n",
+                f"round 1: request {i} returned png bytes",
+            )
+        _require(oom_state["fired"], "round 1: the OOM plan fired")
+
+        text = await (await client.get("/metrics")).text()
+        _require(
+            _metric_value(text, "flyimg_mem_oom_launches_total") == 1.0,
+            "exactly one OOM launch counted",
+        )
+        _require(
+            _metric_value(text, "flyimg_poison_isolated_total") == 0.0,
+            "nothing bisected into quarantine",
+        )
+        _require(
+            _metric_value(text, "flyimg_quarantine_hits_total") == 0.0,
+            "zero quarantine hits",
+        )
+        _require(
+            _metric_value(
+                text, 'flyimg_mem_ceiling_probes_total{outcome="halve"}'
+            ) >= 1.0,
+            "the ceiling halved on OOM",
+        )
+
+        # round 2: the same family pre-splits at the ceiling — no
+        # second OOM discovery — and success at the cap re-probes it
+        responses = await wedged_batch(
+            sources[1 + N_BATCH], sources[2 + N_BATCH:2 + 2 * N_BATCH],
+            "round 2",
+        )
+        for i, resp in enumerate(responses):
+            _require(
+                resp.status == 200,
+                f"round 2: request {i} served under the ceiling "
+                f"(got {resp.status})",
+            )
+
+        text = await (await client.get("/metrics")).text()
+        _require(
+            _metric_value(text, "flyimg_mem_oom_launches_total") == 1.0,
+            "no second OOM: the ceiling pre-split instead",
+        )
+        _require(
+            _metric_value(text, "flyimg_mem_presplits_total") >= 1.0,
+            "the ceiling pre-split the second batch",
+        )
+        _require(
+            _metric_value(
+                text, 'flyimg_mem_ceiling_probes_total{outcome="raise"}'
+            ) >= 1.0,
+            "sustained success re-probed the ceiling upward",
+        )
+
+        doc = json.loads(await (await client.get("/debug/memory")).text())
+        _require(
+            doc["governor"]["enabled"] is True,
+            "/debug/memory governor snapshot present",
+        )
+        ceilings = doc["governor"]["ceilings"]
+        _require(bool(ceilings), "the family still carries a ceiling")
+        cap = next(iter(ceilings.values()))["cap_members"]
+        _require(
+            cap >= N_BATCH // 2 + 1,
+            f"ceiling capped at {N_BATCH // 2} then re-probed (cap {cap})",
+        )
+        print(
+            f"memory smoke OK: {N_BATCH} requests 200 through an OOM'd "
+            f"launch, zero quarantine, ceiling halved to "
+            f"{N_BATCH // 2} and re-probed to {cap}"
+        )
+    finally:
+        await client.close()
+
+
+async def rss_brownout_smoke() -> None:
+    import numpy as np
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from flyimg_tpu.appconfig import AppParameters
+    from flyimg_tpu.codecs import encode
+    from flyimg_tpu.service.app import make_app
+    from flyimg_tpu.testing import faults
+
+    tmp = tempfile.mkdtemp(prefix="flyimg-memsmoke-rss-")
+    rng = np.random.default_rng(1)
+    src = os.path.join(tmp, "src.png")
+    with open(src, "wb") as fh:
+        fh.write(
+            encode(rng.integers(0, 200, (40, 56, 3), dtype=np.uint8), "png")
+        )
+
+    limit = 1 << 30
+    forced = {"rss": float(limit) * 0.95}
+    injector = faults.FaultInjector()
+    injector.plan("mem.rss", lambda **_: forced["rss"])
+    app = make_app(AppParameters({
+        "tmp_dir": os.path.join(tmp, "t"),
+        "upload_dir": os.path.join(tmp, "u"),
+        "batch_deadline_ms": 2.0,
+        "brownout_enable": True,
+        "brownout_min_dwell_s": 0.0,
+        "brownout_eval_interval_s": 0.0,
+        "mem_rss_limit_bytes": limit,
+    }))
+    # the injector is installed by hand (not via params) so the plan
+    # can be swapped live below without rebuilding the app
+    faults.install(injector)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        resp = await asyncio.wait_for(
+            client.get(f"/upload/w_30,o_png/{src}"),
+            timeout=REQUEST_TIMEOUT_S,
+        )
+        _require(
+            resp.status == 200,
+            f"request served under memory pressure (got {resp.status})",
+        )
+        text = await (await client.get("/metrics")).text()
+        _require(
+            _metric_value(text, "flyimg_mem_rss_bytes") == forced["rss"],
+            "forced rss sample exported",
+        )
+        level = _metric_value(text, "flyimg_brownout_level")
+        _require(
+            level >= 2.0,
+            f"rss pressure at 95% of the limit escalated brownout "
+            f"(level {level})",
+        )
+        # pressure clears: the level must walk back down to NORMAL
+        forced["rss"] = float(limit) * 0.05
+        level = None
+        for _ in range(100):
+            await asyncio.sleep(0.05)
+            text = await (await client.get("/metrics")).text()
+            level = _metric_value(text, "flyimg_brownout_level")
+            if level == 0.0:
+                break
+        _require(
+            level == 0.0,
+            f"brownout level walked back to NORMAL (level {level})",
+        )
+        print("memory smoke OK: rss pressure walked brownout up and down")
+    finally:
+        await client.close()
+        faults.clear()
+
+
+async def main() -> int:
+    await oom_recovery_smoke()
+    await rss_brownout_smoke()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(asyncio.run(main()))
